@@ -1,0 +1,29 @@
+"""Test harness: run everything on a virtual 8-device CPU platform.
+
+SPMD/collective logic is CI-testable without TPU hardware via
+XLA's host-platform device-count override (SURVEY.md §5 tier-3); the axon
+sitecustomize pins jax_platforms to the TPU plugin, so we must both set the
+flag before backend initialization and override the platform back to cpu.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

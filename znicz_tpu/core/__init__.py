@@ -1,0 +1,7 @@
+"""Core runtime: config, logging, PRNG, memory, unit graph, backends.
+
+Rebuilds the substrate layers of the reference (SURVEY.md §2 L1-L4):
+veles/config.py, veles/logger.py, veles/prng/, veles/memory.py,
+veles/mutable.py, veles/units.py, veles/workflow.py, veles/plumbing.py,
+veles/backends.py, veles/accelerated_units.py, veles/distributable.py.
+"""

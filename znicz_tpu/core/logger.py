@@ -1,0 +1,57 @@
+"""Logger mixin — rebuild of veles/logger.py :: Logger.
+
+Every framework object mixes this in to get a named, lazily-created logger
+(``self.info(...)``, ``self.debug(...)``, ...).  The reference adds colored
+console output and an optional MongoDB sink; here the sink is stdlib logging
+(the host side of a TPU pod writes plain text / jsonl — see
+znicz_tpu.utils.metrics for structured metrics).
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+_configured = False
+
+
+def configure(level: int = logging.INFO) -> None:
+    global _configured
+    if not _configured:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+        _configured = True
+
+
+class Logger:
+    """Mixin: named logger + convenience methods."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        log = getattr(self, "_logger", None)
+        if log is None:
+            configure()
+            log = logging.getLogger(type(self).__name__)
+            self._logger = log
+        return log
+
+    def debug(self, msg: str, *args) -> None:
+        self.logger.debug(msg, *args)
+
+    def info(self, msg: str, *args) -> None:
+        self.logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self.logger.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self.logger.error(msg, *args)
+
+    # pickling: loggers hold locks/handlers; drop and recreate lazily
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_logger", None)
+        return state

@@ -1,0 +1,124 @@
+"""Deterministic seeded PRNG — rebuild of veles/prng/random_generator.py.
+
+The reference routes *every* stochastic decision (weight init, dataset
+shuffles, dropout masks, stochastic pooling) through a process-global seeded
+xorshift generator registry, ``prng.get(key)`` — that is what makes its
+functional tests bit-reproducible.  We keep the same API and the same
+guarantee (same seed => same run) with a TPU-native split:
+
+- host-side draws (weight init, shuffles) use a ``numpy.random.Generator``
+  (PCG64) per named generator — sequential, stateful, picklable;
+- device-side draws (dropout, stochastic pooling) use counter-based
+  ``jax.random`` keys minted from the same seed via ``key()`` — each call
+  folds in a monotonically increasing counter, so trace-time key extraction
+  is deterministic and snapshot/resume can restore the counter.
+
+Bit-parity with the reference's xorshift stream is a non-goal (SURVEY.md §8);
+self-determinism is the tested contract.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+import jax
+
+
+class RandomGenerator:
+    """One named deterministic stream (reference: RandomGenerator)."""
+
+    def __init__(self, name: str, seed: int | None = None) -> None:
+        self.name = name
+        self.seed(seed if seed is not None else 0xDEADBEEF)
+
+    # -- lifecycle ----------------------------------------------------------
+    def seed(self, seed: int) -> None:
+        self._seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+        self._np = np.random.Generator(np.random.PCG64(self._seed))
+        self._key_counter = 0
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    # -- host-side draws (numpy, stateful-sequential) -----------------------
+    def uniform(self, low: float, high: float, size=None, dtype=np.float32):
+        return self._np.uniform(low, high, size).astype(dtype, copy=False)
+
+    def normal(self, loc: float = 0.0, scale: float = 1.0, size=None,
+               dtype=np.float32):
+        return self._np.normal(loc, scale, size).astype(dtype, copy=False)
+
+    def randint(self, low: int, high: int, size=None):
+        return self._np.integers(low, high, size)
+
+    def shuffle(self, arr) -> None:
+        self._np.shuffle(arr)
+
+    def permutation(self, n: int):
+        return self._np.permutation(n)
+
+    def fill(self, arr: np.ndarray, low: float = -1.0, high: float = 1.0) -> None:
+        """In-place uniform fill, the reference's weight-init primitive."""
+        arr[...] = self._np.uniform(low, high, arr.shape).astype(arr.dtype)
+
+    # -- device-side draws (counter-based jax keys) -------------------------
+    def key(self) -> jax.Array:
+        """Mint a fresh ``jax.random`` key; deterministic per (seed, counter)."""
+        self._key_counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._key_counter)
+
+    # -- snapshot support ---------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "seed": self._seed,
+            "np_state": self._np.bit_generator.state,
+            "key_counter": self._key_counter,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._seed = state["seed"]
+        self._np = np.random.Generator(np.random.PCG64())
+        self._np.bit_generator.state = state["np_state"]
+        self._key_counter = state["key_counter"]
+
+
+_generators: dict[str, RandomGenerator] = {}
+_session_seed: int = 0xDEADBEEF
+
+
+def _derive(seed: int, name: str) -> int:
+    """Stable per-name seed derivation (crc32, not builtin hash — the latter
+    is randomized per process and would break cross-process determinism)."""
+    return seed if name == "default" else seed ^ zlib.crc32(name.encode())
+
+
+def get(key: str = "default") -> RandomGenerator:
+    """The reference's ``prng.get()`` registry accessor.  Streams created
+    after ``seed_all`` derive from the session seed, so creation order
+    relative to seeding does not matter."""
+    gen = _generators.get(key)
+    if gen is None:
+        gen = _generators[key] = RandomGenerator(key, _derive(_session_seed, key))
+    return gen
+
+
+def seed_all(seed: int) -> None:
+    """Set the session seed and reseed all streams (existing and future)
+    deterministically — the CLI ``--random-seed`` entry point."""
+    global _session_seed
+    _session_seed = int(seed)
+    for name, gen in _generators.items():
+        gen.seed(_derive(_session_seed, name))
+    get("default")
+
+
+def state_dict() -> dict:
+    return {name: gen.state_dict() for name, gen in _generators.items()}
+
+
+def load_state_dict(state: dict) -> None:
+    for name, gen_state in state.items():
+        get(name).load_state_dict(gen_state)

@@ -1,0 +1,143 @@
+"""Workflow — unit container + deterministic control-graph executor.
+
+Rebuild of veles/workflow.py :: Workflow.  Differences from the reference are
+execution-model only (SURVEY.md §8 design stance): instead of a ThreadPool
+firing unit callbacks concurrently, ``run()`` performs a deterministic
+breadth-first walk of the control graph from ``start_point`` until the queue
+drains or ``end_point`` fires.  Device work stays asynchronous underneath via
+XLA's dispatch stream, so the host walk is not the throughput bottleneck; the
+accelerated segment is additionally fused into one jitted step by
+znicz_tpu.parallel (the TPU replacement for per-unit kernel enqueues).
+
+Keeps: child-unit management, initialize fan-out with device injection,
+per-unit timing statistics table, stop propagation, and the distributed
+delegation points (generate/apply data for master/slave — retained as API
+for checkpoint/ensemble tooling; the SPMD plane makes the job protocol
+unnecessary, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core.plumbing import EndPoint, StartPoint
+from znicz_tpu.core.units import Unit
+
+
+class Workflow(Unit):
+    """Container unit: owns child units, start/end points, run statistics."""
+
+    def __init__(self, workflow: Optional["Workflow"] = None,
+                 name: Optional[str] = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.units: list[Unit] = []
+        self.start_point = StartPoint(self)
+        self.end_point = EndPoint(self)
+        self.device = None
+        self._wall_time = 0.0
+
+    # -- child management ---------------------------------------------------
+    def add_unit(self, unit: Unit) -> None:
+        if unit not in self.units:
+            self.units.append(unit)
+            unit.workflow = self
+
+    def del_unit(self, unit: Unit) -> None:
+        if unit in self.units:
+            self.units.remove(unit)
+            unit.unlink_all()
+            unit.workflow = None
+
+    def __iter__(self):
+        return iter(self.units)
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, device=None, **kwargs) -> None:
+        """Initialize children in control-topology order (providers first),
+        injecting the device into every unit that accepts one."""
+        self.device = device
+        for unit in self._topo_order():
+            if not unit.initialized:
+                unit.initialize(device=device, **kwargs)
+                unit.initialized = True
+        self.initialized = True
+
+    def _topo_order(self) -> list[Unit]:
+        """Children sorted so control providers come before consumers
+        (cycles — the Repeater back-edge — broken by visit order)."""
+        order: list[Unit] = []
+        seen: set[int] = set()
+
+        def visit(unit: Unit, stack: set[int]) -> None:
+            uid = id(unit)
+            if uid in seen or uid in stack:
+                return
+            stack.add(uid)
+            for provider in unit.links_from:
+                if provider in self.units:
+                    visit(provider, stack)
+            stack.discard(uid)
+            seen.add(uid)
+            order.append(unit)
+
+        visit(self.start_point, set())
+        for unit in self.units:
+            visit(unit, set())
+        return order
+
+    def run(self) -> None:
+        """Walk the control graph from start_point until end_point fires or
+        the signal queue drains."""
+        if not self.initialized:
+            raise RuntimeError("Workflow.run before initialize")
+        started = time.monotonic()
+        self.end_point.reached = False
+        # clear fired-marks left by an early-terminated previous walk so join
+        # units cannot fire on stale signals
+        for unit in self.units:
+            for provider in unit.links_from:
+                unit.links_from[provider] = False
+        queue: deque[tuple[Unit, Unit]] = deque()
+        self.start_point._signal(None, queue)
+        while queue:
+            source, target = queue.popleft()
+            target._signal(source, queue)
+            if self.end_point.reached:
+                break
+        self._wall_time += time.monotonic() - started
+        self.run_was_called = True
+
+    def stop(self) -> None:
+        for unit in self.units:
+            unit.stop()
+        self.stopped = True
+
+    # -- statistics ---------------------------------------------------------
+    def timing_table(self) -> str:
+        """Per-unit wall-time share table (reference: printed at stop)."""
+        rows = sorted(((u._run_time, u._run_count, u.name) for u in self.units),
+                      reverse=True)
+        total = sum(r[0] for r in rows) or 1e-12
+        lines = [f"{'unit':<28}{'runs':>8}{'time_s':>10}{'share':>8}"]
+        for run_time, count, name in rows:
+            if count == 0:
+                continue
+            lines.append(
+                f"{name:<28}{count:>8}{run_time:>10.3f}{run_time / total:>8.1%}")
+        return "\n".join(lines)
+
+    # -- distributed API surface (kept for tooling parity; see module doc) --
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_slave(self, data, slave=None) -> None:
+        pass
+
+    def generate_data_for_master(self):
+        return None
+
+    def apply_data_from_master(self, data) -> None:
+        pass
