@@ -1,0 +1,76 @@
+"""Tier-1 tests for the loader subsystem: epoch/class structure, tail
+padding, deterministic shuffling (SURVEY.md §5 tier-3 loader tests)."""
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import NumpyDevice
+from znicz_tpu.core.workflow import Workflow
+from znicz_tpu.loader.base import TEST, VALID, TRAIN
+from znicz_tpu.loader.synthetic import (SyntheticClassifierLoader,
+                                        SyntheticRegressionLoader)
+
+
+def make_loader(**kwargs):
+    prng.seed_all(99)
+    w = Workflow(name="t")
+    loader = SyntheticClassifierLoader(
+        w, n_classes=4, sample_shape=(6,), **kwargs)
+    loader.initialize(device=NumpyDevice())
+    return loader
+
+
+def test_epoch_class_order_and_padding():
+    # train=100, valid=40, minibatch=30 -> valid: 30+10pad, train: 30*3+10pad
+    loader = make_loader(n_train=100, n_valid=40, minibatch_size=30)
+    seen = []
+    for _ in range(2 + 4):
+        loader.run()
+        seen.append((loader.minibatch_class, loader.minibatch_size,
+                     loader.last_minibatch))
+    assert seen == [
+        (VALID, 30, False), (VALID, 10, True),
+        (TRAIN, 30, False), (TRAIN, 30, False), (TRAIN, 30, False),
+        (TRAIN, 10, True),
+    ]
+    assert loader.epoch_ended and loader.epoch_number == 1
+    # padded tail rows are zeroed, indices -1
+    assert np.all(loader.minibatch_indices.mem[10:] == -1)
+    assert np.all(loader.minibatch_data.mem[10:] == 0)
+
+
+def test_train_shuffles_per_epoch_deterministically():
+    def epoch_indices(seed):
+        prng.seed_all(seed)
+        w = Workflow(name="t")
+        loader = SyntheticClassifierLoader(
+            w, n_classes=2, sample_shape=(3,), n_train=20, n_valid=0,
+            minibatch_size=20)
+        loader.initialize(device=NumpyDevice())
+        out = []
+        for _ in range(2):
+            loader.run()
+            out.append(loader.minibatch_indices.mem.copy())
+        return out
+
+    a1, a2 = epoch_indices(5)
+    b1, b2 = epoch_indices(5)
+    np.testing.assert_array_equal(a1, b1)   # deterministic across runs
+    np.testing.assert_array_equal(a2, b2)
+    assert not np.array_equal(a1, a2)       # reshuffled across epochs
+
+
+def test_regression_loader_serves_targets():
+    prng.seed_all(3)
+    w = Workflow(name="t")
+    loader = SyntheticRegressionLoader(w, sample_shape=(8,), target_shape=(2,),
+                                       n_train=32, n_valid=8,
+                                       minibatch_size=16)
+    loader.initialize(device=NumpyDevice())
+    loader.run()
+    assert loader.minibatch_targets.shape == (16, 2)
+    assert loader.minibatch_class == VALID
+    idx = loader.minibatch_indices.mem[:loader.minibatch_size]
+    np.testing.assert_array_equal(
+        loader.minibatch_targets.mem[:8],
+        loader.original_targets.mem[idx])

@@ -1,0 +1,116 @@
+"""Tier-2 functional test: the minimum end-to-end slice (SURVEY.md §8 step 2)
+— an MNIST-shaped FC workflow (All2AllTanh -> All2AllSoftmax ->
+EvaluatorSoftmax -> DecisionGD -> GDSoftmax -> GDTanh) converging under the
+Repeater loop, deterministic across runs with the same seed.
+
+Wiring mirrors the reference call stack (SURVEY.md §4.1):
+Repeater -> Loader -> forwards -> Evaluator -> Decision -> gds (reverse) ->
+Repeater, with end_point gated on ~decision.complete and gds skipped on
+non-train minibatches.
+"""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import NumpyDevice, TPUDevice
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core.plumbing import Repeater
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.loader.synthetic import SyntheticClassifierLoader
+from znicz_tpu.units.all2all import All2AllSoftmax, All2AllTanh
+from znicz_tpu.units.decision import DecisionGD
+from znicz_tpu.units.evaluator import EvaluatorSoftmax
+from znicz_tpu.units.gd import GDSoftmax, GDTanh
+from znicz_tpu.units.nn_units import NNWorkflow
+
+
+def build_fc_workflow(max_epochs=4, lr=0.05):
+    w = NNWorkflow(name="MnistFC")
+    w.repeater = Repeater(w)
+    loader = w.loader = SyntheticClassifierLoader(
+        w, n_classes=10, sample_shape=(28, 28), n_train=600, n_valid=200,
+        minibatch_size=50, spread=2.5, noise=1.0)
+    fc1 = All2AllTanh(w, output_sample_shape=64, name="fc1")
+    fc2 = All2AllSoftmax(w, output_sample_shape=10, name="fc2")
+    w.forwards = [fc1, fc2]
+    ev = w.evaluator = EvaluatorSoftmax(w)
+    dec = w.decision = DecisionGD(w, max_epochs=max_epochs)
+    gd2 = GDSoftmax(w, learning_rate=lr, gradient_moment=0.9, name="gd2")
+    gd1 = GDTanh(w, learning_rate=lr, gradient_moment=0.9, name="gd1")
+    w.gds = [gd1, gd2]
+
+    # control chain (reference §4.1 hot loop)
+    w.repeater.link_from(w.start_point)
+    loader.link_from(w.repeater)
+    fc1.link_from(loader)
+    fc2.link_from(fc1)
+    ev.link_from(fc2)
+    dec.link_from(ev)
+    gd2.link_from(dec)
+    gd1.link_from(gd2)
+    w.repeater.link_from(gd1)
+    # end after the full backward chain so the last minibatch is symmetric
+    w.end_point.link_from(gd1)
+    w.end_point.gate_block = ~dec.complete
+
+    # gradient units run on train minibatches only
+    for gd in (gd1, gd2):
+        gd.gate_skip = Bool(lambda: int(loader.minibatch_class) != TRAIN)
+
+    # data links
+    fc1.link_attrs(loader, ("input", "minibatch_data"))
+    fc2.link_attrs(fc1, ("input", "output"))
+    ev.link_attrs(fc2, "output", "max_idx")
+    ev.link_attrs(loader, ("labels", "minibatch_labels"),
+                  ("batch_size", "minibatch_size"))
+    dec.link_attrs(loader, "minibatch_class", "last_minibatch",
+                   "class_lengths", "epoch_number", "minibatch_size")
+    dec.link_attrs(ev, ("minibatch_n_err", "n_err"))
+    dec.evaluator = ev
+    gd2.link_from_forward(fc2)
+    gd2.link_attrs(ev, "err_output")
+    gd2.link_attrs(loader, ("batch_size", "minibatch_size"))
+    gd1.link_from_forward(fc1)
+    gd1.link_attrs(gd2, ("err_output", "err_input"))
+    gd1.link_attrs(loader, ("batch_size", "minibatch_size"))
+    return w
+
+
+def run_workflow(device, seed=123, max_epochs=4):
+    prng.seed_all(seed)
+    w = build_fc_workflow(max_epochs=max_epochs)
+    w.initialize(device=device)
+    w.run()
+    return w
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, TPUDevice])
+def test_fc_workflow_converges(device_cls):
+    w = run_workflow(device_cls())
+    dec = w.decision
+    assert bool(dec.complete)
+    assert len(dec.metrics_history) == 4
+    # synthetic blobs are nearly separable: validation error must collapse
+    first = dec.metrics_history[0]["metric_validation"]
+    last = dec.metrics_history[-1]["metric_validation"]
+    assert last < first, (first, last)
+    assert dec.epoch_n_err_pt[1] < 15.0, dec.metrics_history
+
+
+def test_fc_workflow_deterministic():
+    h1 = run_workflow(TPUDevice(), seed=7, max_epochs=2)
+    h2 = run_workflow(TPUDevice(), seed=7, max_epochs=2)
+    assert h1.decision.metrics_history == h2.decision.metrics_history
+    np.testing.assert_array_equal(h1.forwards[0].weights.map_read(),
+                                  h2.forwards[0].weights.map_read())
+
+
+def test_fc_workflow_backends_agree():
+    """numpy oracle vs XLA backend: same seed, same epoch error counts
+    (float32 GEMM on CPU-XLA matches numpy within integer-count tolerance)."""
+    h_np = run_workflow(NumpyDevice(), seed=11, max_epochs=2)
+    h_x = run_workflow(TPUDevice(), seed=11, max_epochs=2)
+    for m_np, m_x in zip(h_np.decision.metrics_history,
+                         h_x.decision.metrics_history):
+        assert abs(m_np["metric_validation"] - m_x["metric_validation"]) <= 2

@@ -1,0 +1,105 @@
+"""Tier-1 tests for the pure op layer (SURVEY.md §5: per-op parity vs a
+numpy re-derivation + numeric-derivative checks)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from znicz_tpu.ops import activations, linear, sgd
+
+ACTS = [activations.LINEAR, activations.TANH, activations.RELU,
+        activations.STRICT_RELU, activations.SIGMOID]
+
+
+@pytest.mark.parametrize("act", ACTS)
+def test_activation_derivative_matches_numeric(act):
+    rng = np.random.default_rng(0)
+    # keep away from the strict_relu kink where the numeric diff is invalid
+    x = rng.uniform(0.1, 2.0, 64).astype(np.float64) * \
+        np.where(rng.uniform(size=64) < 0.5, -1.0, 1.0)
+    eps = 1e-6
+    y = activations.forward(np, act, x)
+    dy = activations.derivative_from_output(np, act, y)
+    num = (activations.forward(np, act, x + eps) -
+           activations.forward(np, act, x - eps)) / (2 * eps)
+    np.testing.assert_allclose(dy, num, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("act", ACTS)
+def test_activation_numpy_vs_jnp(act):
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    got = np.asarray(activations.forward(jnp, act, jnp.asarray(x)))
+    want = activations.forward(np, act, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_all2all_forward_golden():
+    x = np.array([[1.0, 2.0]], np.float32)
+    w = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    b = np.array([0.5, -0.5], np.float32)
+    y = linear.forward(np, x, w, b)
+    np.testing.assert_allclose(y, [[1.5, 1.5]])
+
+
+def test_softmax_forward_rows_sum_to_one():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    w = rng.normal(size=(6, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    y, idx = linear.softmax_forward(np, x, w, b)
+    np.testing.assert_allclose(y.sum(axis=1), np.ones(4), rtol=1e-6)
+    v = x @ w + b
+    np.testing.assert_array_equal(idx, v.argmax(axis=1))
+    yj, idxj = linear.softmax_forward(jnp, jnp.asarray(x), jnp.asarray(w),
+                                      jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(yj), y, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idxj), idx)
+
+
+@pytest.mark.parametrize("act", ACTS)
+def test_all2all_backward_numeric_gradient(act):
+    """Analytic err_input / grad_w / grad_b vs central differences of a
+    scalar loss L = sum(y * r) (r fixed random)."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(3, 5)).astype(np.float64)
+    w = rng.normal(size=(5, 4)).astype(np.float64)
+    b = rng.normal(size=(4,)).astype(np.float64)
+    r = rng.normal(size=(3, 4)).astype(np.float64)
+
+    def loss(x_, w_, b_):
+        return float((linear.forward(np, x_, w_, b_, act) * r).sum())
+
+    y = linear.forward(np, x, w, b, act)
+    err_in, gw, gb = linear.backward(np, x, y, w, r, act)
+
+    eps = 1e-6
+    for arr, grad in ((x, err_in), (w, gw), (b, gb)):
+        it = np.nditer(arr, flags=["multi_index"])
+        for _ in it:
+            i = it.multi_index
+            orig = arr[i]
+            arr[i] = orig + eps
+            lp = loss(x, w, b)
+            arr[i] = orig - eps
+            lm = loss(x, w, b)
+            arr[i] = orig
+            np.testing.assert_allclose(
+                grad[i], (lp - lm) / (2 * eps), rtol=1e-4, atol=1e-6)
+
+
+def test_sgd_update_momentum_and_decay():
+    w = np.full((4,), 2.0)
+    grad = np.full((4,), 8.0)
+    vel = np.full((4,), 1.0)
+    # g = 8/4 + 0.1*w = 2.2 ; vel = 0.5*1 + 0.1*2.2 = 0.72 ; w = 2 - 0.72
+    w2, vel2 = sgd.update(np, w, grad, vel, learning_rate=0.1,
+                          weights_decay=0.1, l1_vs_l2=0.0,
+                          gradient_moment=0.5, batch_size=4)
+    np.testing.assert_allclose(vel2, 0.72)
+    np.testing.assert_allclose(w2, 1.28)
+    # jnp twin
+    w2j, vel2j = sgd.update(jnp, jnp.asarray(w), jnp.asarray(grad),
+                            jnp.asarray(vel), 0.1, 0.1, 0.0, 0.5, 4)
+    np.testing.assert_allclose(np.asarray(w2j), w2, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vel2j), vel2, rtol=1e-6)

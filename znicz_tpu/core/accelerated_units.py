@@ -40,6 +40,9 @@ class AcceleratedUnit(Unit):
     def __init__(self, workflow=None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.device: Optional[Device] = None
+        #: true (unpadded) minibatch row count, usually data-linked to the
+        #: loader; see current_batch_size()
+        self.batch_size = None
 
     # -- dispatch -----------------------------------------------------------
     @property
@@ -78,6 +81,14 @@ class AcceleratedUnit(Unit):
     def init_array(self, *arrays: Array) -> None:
         for arr in arrays:
             arr.initialize(self.device)
+
+    def current_batch_size(self, fallback: Optional[Array] = None) -> int:
+        """True (unpadded) minibatch size: the data-linked ``batch_size``
+        when wired, else the row count of ``fallback``; never 0."""
+        bs = self.batch_size
+        if bs is None and fallback is not None:
+            bs = len(fallback)
+        return max(int(bs or 0), 1)
 
     @staticmethod
     def jit(fn, **jit_kwargs):

@@ -78,7 +78,13 @@ class TPUDevice(Device):
                                 and self.platform == "tpu") else jnp.float32
 
     def put(self, host_array: np.ndarray) -> jax.Array:
-        return jax.device_put(host_array, self.jax_device)
+        # device_put transfers asynchronously and reads the source buffer
+        # until the transfer completes; callers (the Loader hot path) reuse
+        # and mutate their host buffers per minibatch, so hand the transfer
+        # a private copy — otherwise runs are nondeterministic under async
+        # dispatch (observed as run-to-run weight divergence).
+        return jax.device_put(np.array(host_array, copy=True),
+                              self.jax_device)
 
     def synchronize(self) -> None:
         (jax.device_put(0.0, self.jax_device) + 0).block_until_ready()

@@ -26,6 +26,7 @@ from __future__ import annotations
 import time
 from typing import TYPE_CHECKING, Dict, Optional
 
+from znicz_tpu.core.distributable import Distributable
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.mutable import Bool, LinkableAttribute
 
@@ -33,8 +34,9 @@ if TYPE_CHECKING:
     from znicz_tpu.core.workflow import Workflow
 
 
-class Unit(Logger):
-    """Base control/data-graph node."""
+class Unit(Logger, Distributable):
+    """Base control/data-graph node.  Inherits the Distributable protocol
+    stubs (reference: every Unit is Distributable)."""
 
     def __init__(self, workflow: Optional["Workflow"] = None,
                  name: Optional[str] = None, **kwargs) -> None:

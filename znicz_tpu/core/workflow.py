@@ -66,26 +66,33 @@ class Workflow(Unit):
         self.initialized = True
 
     def _topo_order(self) -> list[Unit]:
-        """Children sorted so control providers come before consumers
-        (cycles — the Repeater back-edge — broken by visit order)."""
-        order: list[Unit] = []
+        """Children in control-flow order: iterative DFS from
+        ``start_point`` along ``links_to``, emitting reverse finish order —
+        a topological sort of the control DAG with cycle back-edges (the
+        Repeater loop) ignored.  Unlike a plain BFS this guarantees every
+        provider of a join unit initializes before the join unit itself
+        (e.g. an evaluator linked from both the loader and the last
+        forward).  Unreached units follow in insertion order."""
+        finish: list[Unit] = []
         seen: set[int] = set()
-
-        def visit(unit: Unit, stack: set[int]) -> None:
-            uid = id(unit)
-            if uid in seen or uid in stack:
-                return
-            stack.add(uid)
-            for provider in unit.links_from:
-                if provider in self.units:
-                    visit(provider, stack)
-            stack.discard(uid)
-            seen.add(uid)
-            order.append(unit)
-
-        visit(self.start_point, set())
+        stack: list[tuple[Unit, int]] = [(self.start_point, 0)]
+        seen.add(id(self.start_point))
+        while stack:
+            unit, child = stack[-1]
+            if child < len(unit.links_to):
+                stack[-1] = (unit, child + 1)
+                target = unit.links_to[child]
+                if id(target) not in seen:
+                    seen.add(id(target))
+                    stack.append((target, 0))
+            else:
+                stack.pop()
+                finish.append(unit)
+        order = finish[::-1]
         for unit in self.units:
-            visit(unit, set())
+            if id(unit) not in seen:
+                seen.add(id(unit))
+                order.append(unit)
         return order
 
     def run(self) -> None:
@@ -129,15 +136,4 @@ class Workflow(Unit):
                 f"{name:<28}{count:>8}{run_time:>10.3f}{run_time / total:>8.1%}")
         return "\n".join(lines)
 
-    # -- distributed API surface (kept for tooling parity; see module doc) --
-    def generate_data_for_slave(self, slave=None):
-        return None
-
-    def apply_data_from_slave(self, data, slave=None) -> None:
-        pass
-
-    def generate_data_for_master(self):
-        return None
-
-    def apply_data_from_master(self, data) -> None:
-        pass
+# (distributed state protocol: inherited from Distributable via Unit)

@@ -1,0 +1,12 @@
+"""Data loaders — rebuild of veles/loader/ (SURVEY.md §2 L9 note).
+
+``Loader`` serves fixed-size minibatches across the TEST/VALID/TRAIN sample
+classes each epoch with deterministic shuffling; ``FullBatchLoader`` holds
+the whole dataset in one Array (optionally device-resident).
+"""
+
+from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, CLASS_NAMES
+from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+
+__all__ = ["Loader", "FullBatchLoader", "FullBatchLoaderMSE",
+           "TEST", "VALID", "TRAIN", "CLASS_NAMES"]

@@ -1,0 +1,173 @@
+"""Loader base — rebuild of veles/loader/base.py :: Loader.
+
+Epoch structure (reference semantics): each epoch serves all three sample
+classes in order TEST -> VALID -> TRAIN, in fixed-size minibatches; only the
+train set is reshuffled (deterministically, via prng) at each epoch start.
+``last_minibatch`` marks the final minibatch of a class pass;
+``epoch_ended`` flips when the train pass finishes and ``epoch_number``
+increments.
+
+Static-shape policy (SURVEY.md §8): the served arrays always have
+``max_minibatch_size`` rows; a short tail is padded and the true row count
+exposed as ``minibatch_size`` — evaluator/GD mask/divide by it.  This is
+what keeps every XLA step the same compiled shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+
+#: sample classes (reference: veles/loader/base.py :: CLASS_NAMES order)
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class Loader(AcceleratedUnit):
+    """Minibatch server over an abstract dataset."""
+
+    def __init__(self, workflow=None, minibatch_size: int = 100,
+                 shuffle_limit: Optional[int] = None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.max_minibatch_size = int(minibatch_size)
+        #: epochs to keep shuffling for (None = always; 0 = never)
+        self.shuffle_limit = shuffle_limit
+        # served state (data-linked by downstream units)
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_targets = Array()
+        self.minibatch_indices = Array()
+        self.minibatch_size = 0          # true (unpadded) row count
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0
+        self.last_minibatch = False
+        self.epoch_number = 0
+        self.epoch_ended = False
+        # dataset geometry, set by load_data()
+        self.class_lengths = [0, 0, 0]
+        self._position = 0               # offset within current class
+        self._class = TEST
+        self._shuffled: dict[int, np.ndarray] = {}
+
+    # -- override points ----------------------------------------------------
+    def load_data(self) -> None:
+        """Set ``class_lengths`` and prepare backing storage."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self) -> None:
+        """Allocate ``minibatch_data`` (and labels/targets if served)."""
+        raise NotImplementedError
+
+    def fill_minibatch(self) -> None:
+        """Copy rows selected by ``minibatch_indices`` into the served
+        arrays; indices beyond ``minibatch_size`` are -1 (padding)."""
+        raise NotImplementedError
+
+    # -- geometry helpers ---------------------------------------------------
+    @property
+    def total_samples(self) -> int:
+        return int(sum(self.class_lengths))
+
+    def class_offset(self, cls: int) -> int:
+        """Global sample index where class ``cls`` starts (storage order is
+        [test | validation | train], reference layout)."""
+        return int(sum(self.class_lengths[:cls]))
+
+    @property
+    def has_labels(self) -> bool:
+        return bool(self.minibatch_labels)
+
+    def _nonempty_classes(self) -> list[int]:
+        return [c for c in (TEST, VALID, TRAIN) if self.class_lengths[c] > 0]
+
+    # -- lifecycle ----------------------------------------------------------
+    def _common_init(self, **kwargs) -> None:
+        self.load_data()
+        if self.class_lengths[TRAIN] <= 0:
+            raise ValueError("Loader: empty train set")
+        self.create_minibatch_data()
+        if not self.minibatch_indices:
+            self.minibatch_indices.reset(
+                shape=(self.max_minibatch_size,), dtype=np.int64)
+        self.init_array(self.minibatch_data, self.minibatch_labels,
+                        self.minibatch_targets, self.minibatch_indices)
+        self._class = self._nonempty_classes()[0]
+        self._position = 0
+        self._shuffle_train()
+
+    def _shuffle_train(self) -> None:
+        for cls in self._nonempty_classes():
+            if cls not in self._shuffled:
+                self._shuffled[cls] = np.arange(
+                    self.class_offset(cls),
+                    self.class_offset(cls) + self.class_lengths[cls],
+                    dtype=np.int64)
+        if self.shuffle_limit is not None and \
+                self.epoch_number >= self.shuffle_limit:
+            return
+        prng.get().shuffle(self._shuffled[TRAIN])
+
+    # -- serving ------------------------------------------------------------
+    def numpy_run(self) -> None:
+        self._serve()
+
+    def xla_run(self) -> None:
+        self._serve()
+        # upload the freshly filled host rows
+        for arr in (self.minibatch_data, self.minibatch_labels,
+                    self.minibatch_targets):
+            if arr:
+                arr.unmap()
+
+    def _serve(self) -> None:
+        self.epoch_ended = False
+        cls = self._class
+        length = self.class_lengths[cls]
+        start = self._position
+        count = min(self.max_minibatch_size, length - start)
+        indices = np.full((self.max_minibatch_size,), -1, dtype=np.int64)
+        indices[:count] = self._shuffled[cls][start:start + count]
+        self.minibatch_indices.map_invalidate()
+        self.minibatch_indices.mem = indices
+        self.minibatch_size = count
+        self.minibatch_class = cls
+        self.minibatch_offset = start
+        self._position = start + count
+        self.last_minibatch = self._position >= length
+        self.fill_minibatch()
+        if self.last_minibatch:
+            self._advance_class()
+
+    def _advance_class(self) -> None:
+        classes = self._nonempty_classes()
+        idx = classes.index(self._class)
+        if idx + 1 < len(classes):
+            self._class = classes[idx + 1]
+        else:
+            # train pass done -> epoch boundary
+            self.epoch_number += 1
+            self.epoch_ended = True
+            self._class = classes[0]
+            self._shuffle_train()
+        self._position = 0
+
+    # -- snapshot support ---------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "epoch_number": int(self.epoch_number),
+            "position": int(self._position),
+            "cls": int(self._class),
+            "shuffled": {c: v.copy() for c, v in self._shuffled.items()},
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch_number = state["epoch_number"]
+        self._position = state["position"]
+        self._class = state["cls"]
+        self._shuffled = {c: np.asarray(v) for c, v in
+                          state["shuffled"].items()}

@@ -1,0 +1,72 @@
+"""Full-batch loaders — rebuild of veles/loader/fullbatch.py ::
+FullBatchLoader (+ MSE variant).
+
+The whole dataset lives in one Array pair (``original_data``,
+``original_labels`` / ``original_targets``) in [test | validation | train]
+storage order; ``fill_minibatch`` is a host-side gather (the device-resident
+gather happens inside the fused step in znicz_tpu.parallel, where the whole
+dataset can be device-pinned — reference's ``on_device`` option).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.loader.base import Loader
+
+
+class FullBatchLoader(Loader):
+    """Dataset fully materialized in host memory."""
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.original_data = Array()
+        self.original_labels = Array()
+
+    # subclasses override load_data() to fill original_* + class_lengths
+
+    def create_minibatch_data(self) -> None:
+        sample_shape = self.original_data.shape[1:]
+        self.minibatch_data.reset(
+            shape=(self.max_minibatch_size,) + tuple(sample_shape),
+            dtype=self.original_data.dtype)
+        if self.original_labels:
+            self.minibatch_labels.reset(
+                shape=(self.max_minibatch_size,), dtype=np.int32)
+
+    def fill_minibatch(self) -> None:
+        indices = self.minibatch_indices.mem
+        count = self.minibatch_size
+        idx = indices[:count]
+        data = self.minibatch_data.map_invalidate()
+        data[:count] = self.original_data.mem[idx]
+        data[count:] = 0
+        if self.original_labels:
+            labels = self.minibatch_labels.map_invalidate()
+            labels[:count] = self.original_labels.mem[idx]
+            labels[count:] = 0
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """Full-batch loader also serving regression targets
+    (reference: FullBatchLoaderMSE)."""
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.original_targets = Array()
+
+    def create_minibatch_data(self) -> None:
+        super().create_minibatch_data()
+        target_shape = self.original_targets.shape[1:]
+        self.minibatch_targets.reset(
+            shape=(self.max_minibatch_size,) + tuple(target_shape),
+            dtype=self.original_targets.dtype)
+
+    def fill_minibatch(self) -> None:
+        super().fill_minibatch()
+        indices = self.minibatch_indices.mem
+        count = self.minibatch_size
+        targets = self.minibatch_targets.map_invalidate()
+        targets[:count] = self.original_targets.mem[indices[:count]]
+        targets[count:] = 0

@@ -1,0 +1,106 @@
+"""Deterministic synthetic datasets for tests and benchmarks.
+
+The sandbox has no network egress, so the reference's auto-downloaded
+datasets (MNIST etc.) are replaced by seeded synthetic generators with the
+same shapes; real-dataset loaders (znicz_tpu.loader.mnist) read local files
+when present.  Generation goes through znicz_tpu.core.prng, so tier-2 tests
+stay bit-reproducible (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TEST, VALID, TRAIN
+from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+
+
+def make_blobs(n_per_class: dict[int, int], n_classes: int,
+               sample_shape: tuple, spread: float = 2.0,
+               noise: float = 1.0, stream: str = "synthetic"):
+    """Gaussian-blob classification data in [test|valid|train] order.
+
+    Returns ``(data, labels, class_lengths)``; each class' mean is a seeded
+    random direction scaled by ``spread`` — linearly separable-ish, so small
+    nets converge in a few epochs (what the functional tests pin).
+    """
+    gen = prng.get(stream)
+    dim = int(np.prod(sample_shape))
+    means = gen.normal(0.0, spread, (n_classes, dim))
+    data_parts, label_parts, lengths = [], [], [0, 0, 0]
+    for cls in (TEST, VALID, TRAIN):
+        n = n_per_class.get(cls, 0) * n_classes
+        lengths[cls] = n
+        if n == 0:
+            continue
+        labels = np.tile(np.arange(n_classes), n_per_class[cls])
+        samples = means[labels] + gen.normal(0.0, noise, (n, dim))
+        data_parts.append(samples.astype(np.float32))
+        label_parts.append(labels.astype(np.int32))
+    data = np.concatenate(data_parts).reshape((-1,) + tuple(sample_shape))
+    return data, np.concatenate(label_parts), lengths
+
+
+class SyntheticClassifierLoader(FullBatchLoader):
+    """Seeded Gaussian-blob classification dataset (MNIST stand-in)."""
+
+    def __init__(self, workflow=None, n_classes: int = 10,
+                 sample_shape=(28, 28), n_train: int = 600,
+                 n_valid: int = 100, n_test: int = 0,
+                 spread: float = 2.0, noise: float = 1.0, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_classes = n_classes
+        self.sample_shape = tuple(sample_shape)
+        self.n_per_class = {TEST: n_test // n_classes,
+                            VALID: n_valid // n_classes,
+                            TRAIN: n_train // n_classes}
+        self.spread = spread
+        self.noise = noise
+
+    def load_data(self) -> None:
+        data, labels, lengths = make_blobs(
+            self.n_per_class, self.n_classes, self.sample_shape,
+            self.spread, self.noise)
+        self.original_data.mem = data
+        self.original_labels.mem = labels
+        self.class_lengths = lengths
+
+
+class SyntheticImageLoader(SyntheticClassifierLoader):
+    """Blob classes rendered as (H, W, C) images — conv-stack test data."""
+
+    def __init__(self, workflow=None, sample_shape=(32, 32, 3), **kwargs) -> None:
+        super().__init__(workflow, sample_shape=sample_shape, **kwargs)
+
+
+class SyntheticRegressionLoader(FullBatchLoaderMSE):
+    """Seeded regression dataset: targets are a fixed random linear map of
+    the inputs plus noise (autoencoder/MSE workflow test data)."""
+
+    def __init__(self, workflow=None, sample_shape=(16,), target_shape=(4,),
+                 n_train: int = 512, n_valid: int = 128,
+                 identity: bool = False, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.sample_shape = tuple(sample_shape)
+        self.target_shape = tuple(target_shape)
+        self.n_train = n_train
+        self.n_valid = n_valid
+        #: identity=True -> targets = inputs (autoencoder reconstruction)
+        self.identity = identity
+
+    def load_data(self) -> None:
+        gen = prng.get("synthetic")
+        n = self.n_valid + self.n_train
+        dim = int(np.prod(self.sample_shape))
+        data = gen.normal(0.0, 1.0, (n, dim)).astype(np.float32)
+        if self.identity:
+            targets = data.copy().reshape((n,) + self.sample_shape)
+        else:
+            tdim = int(np.prod(self.target_shape))
+            w = gen.normal(0.0, 1.0 / np.sqrt(dim), (dim, tdim))
+            targets = (data @ w).astype(np.float32).reshape(
+                (n,) + self.target_shape)
+        self.original_data.mem = data.reshape((n,) + self.sample_shape)
+        self.original_targets.mem = targets
+        self.class_lengths = [0, self.n_valid, self.n_train]

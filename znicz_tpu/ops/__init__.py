@@ -1,0 +1,21 @@
+"""Pure functional op layer — the rebuild of the reference's kernel tree
+(veles.znicz ocl/*.cl + cuda/*.cu, SURVEY.md §3.2).
+
+Every op is a pure function parameterized by an array namespace ``xp``
+(``numpy`` for the oracle backend, ``jax.numpy`` for the XLA/TPU backend) —
+the analog of the reference keeping its .cl and .cu kernel sources
+line-for-line parallel.  Units call these with ``xp=numpy`` from
+``numpy_run`` and trace them with ``xp=jax.numpy`` under ``jax.jit`` from
+``xla_run``; the fused training step (znicz_tpu.parallel) composes the jnp
+versions into one XLA program.
+
+Geometry that the reference baked into kernels via ``#define`` (dtype,
+BLOCK_SIZE, kx/ky/stride/padding) is ordinary Python arguments here, closed
+over at trace time — XLA re-specializes per shape exactly the way
+``build_program`` rebuilt per instance.
+
+Pallas implementations of the kernels where hand-fusion is the point live in
+``znicz_tpu.ops.pallas`` with these as their always-available fallback.
+"""
+
+from znicz_tpu.ops import activations, linear, sgd  # noqa: F401

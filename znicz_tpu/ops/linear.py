@@ -1,0 +1,64 @@
+"""Fully-connected (All2All) forward/backward — rebuild of the reference's
+all2all + gradient_descent GEMM kernels (matrix_multiplication.{cl,cu},
+SURVEY.md §3.2).
+
+Layout note (TPU-first design decision): weights are stored **(in, out)** so
+the forward GEMM is ``x @ W`` with no transpose — the MXU-friendly layout.
+The reference stores (out, in) and runs x·Wᵀ; the ``weights_transposed``
+unit flag is honored at the unit level by transposing on load/save, not in
+the hot loop.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.ops import activations
+
+
+def flatten_batch(xp, x):
+    """(B, ...) -> (B, features) — the reference reshapes implicitly."""
+    return x.reshape(x.shape[0], -1)
+
+
+def forward(xp, x, weights, bias, activation: str = activations.LINEAR):
+    """y = act(x·W + b).  ``bias`` may be None (include_bias=False)."""
+    v = flatten_batch(xp, x) @ weights
+    if bias is not None:
+        v = v + bias
+    return activations.forward(xp, activation, v)
+
+
+def softmax_forward(xp, x, weights, bias):
+    """All2AllSoftmax forward: row-max-subtracted exp-normalize.
+
+    Returns ``(y, max_idx)`` — the reference's softmax kernel also emits the
+    argmax per row for the evaluator (SURVEY.md §3.1 All2AllSoftmax).
+    """
+    v = flatten_batch(xp, x) @ weights
+    if bias is not None:
+        v = v + bias
+    m = v.max(axis=1, keepdims=True)
+    e = xp.exp(v - m)
+    y = e / e.sum(axis=1, keepdims=True)
+    return y, v.argmax(axis=1)
+
+
+def backward(xp, x, y, weights, err_output, activation: str,
+             activation_applied: bool = True):
+    """Full backward for one FC layer.
+
+    Returns ``(err_input, grad_weights, grad_bias)`` with gradients
+    **summed over the batch** (normalization by batch size happens in the
+    SGD update, reference semantics).
+
+    ``activation_applied=False`` means err_output is already d/d(pre-act)
+    — the GDSoftmax case, where EvaluatorSoftmax produced y - target.
+    """
+    x_flat = flatten_batch(xp, x)
+    if activation_applied:
+        err_v = activations.backward(xp, activation, y, err_output)
+    else:
+        err_v = err_output
+    err_input = (err_v @ weights.T).reshape(x.shape)
+    grad_weights = x_flat.T @ err_v
+    grad_bias = err_v.sum(axis=0)
+    return err_input, grad_weights, grad_bias

@@ -1,0 +1,38 @@
+"""Fused SGD weight update — rebuild of the reference's weights_update /
+bias_update kernels (gradient_descent.{cl,cu}, SURVEY.md §3.2).
+
+The reference fuses, in one kernel: gradient normalization by batch size,
+L2/L1 weight decay (``weights_decay`` with ``l1_vs_l2`` mixing), momentum
+(``gradient_moment`` into the persistent gradient buffer), and the in-place
+weight apply.  Kept as one fusable function here — XLA fuses it into a
+couple of elementwise HBM passes; the Pallas version
+(znicz_tpu.ops.pallas.sgd) makes the single-pass fusion explicit.
+
+Update rule (reference semantics):
+
+    g     = grad_sum / batch_size
+            + weights_decay * ((1 - l1_vs_l2) * w + l1_vs_l2 * sign(w))
+    vel   = gradient_moment * vel + learning_rate * g
+    w_new = w - vel
+"""
+
+from __future__ import annotations
+
+
+def update(xp, w, grad_sum, vel, learning_rate: float, weights_decay: float,
+           l1_vs_l2: float, gradient_moment: float, batch_size):
+    """One fused SGD step.  Returns ``(w_new, vel_new)``.
+
+    ``vel`` is the persistent momentum buffer (reference:
+    ``gradient_weights`` Array with the moment folded in); pass zeros for
+    the first step.  ``batch_size`` may be a traced scalar (masked tail
+    minibatches divide by the *real* sample count).
+    """
+    g = grad_sum / batch_size
+    if weights_decay:
+        decay = (1.0 - l1_vs_l2) * w
+        if l1_vs_l2:
+            decay = decay + l1_vs_l2 * xp.sign(w)
+        g = g + weights_decay * decay
+    vel_new = gradient_moment * vel + learning_rate * g
+    return w - vel_new, vel_new

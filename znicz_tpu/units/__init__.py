@@ -1,0 +1,6 @@
+"""NN unit library — rebuild of the veles.znicz unit tree (SURVEY.md §3.1).
+
+Forward/gradient unit pairs over the pure ops in ``znicz_tpu.ops``; every
+unit has a ``numpy`` oracle path and an ``xla`` TPU path (the reference's
+numpy/ocl/cuda triple collapsed to numpy/xla).
+"""

@@ -1,0 +1,143 @@
+"""Fully-connected forward units — rebuild of veles.znicz all2all.py ::
+All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU, All2AllSigmoid,
+All2AllSoftmax.
+
+y = act(x·W + b) over ``znicz_tpu.ops.linear``; the Softmax variant also
+emits ``max_idx`` per row for EvaluatorSoftmax (reference behavior).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.ops import activations, linear
+from znicz_tpu.units.nn_units import Forward
+
+
+class All2All(Forward):
+    """Linear fully-connected layer (reference: all2all.py :: All2All)."""
+
+    MAPPING = {"all2all"}
+    ACTIVATION = activations.LINEAR
+
+    def __init__(self, workflow=None, output_sample_shape=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        if output_sample_shape is None:
+            raise ValueError("All2All requires output_sample_shape")
+        self.output_sample_shape = (
+            (output_sample_shape,) if isinstance(output_sample_shape, int)
+            else tuple(output_sample_shape))
+
+    # -- shapes -------------------------------------------------------------
+    @property
+    def n_input(self) -> int:
+        return int(np.prod(self.input.shape[1:]))
+
+    @property
+    def n_output(self) -> int:
+        return int(np.prod(self.output_sample_shape))
+
+    def _common_init(self, **kwargs) -> None:
+        batch = self.input.shape[0]
+        self.init_weights(self.n_input, self.n_output)
+        if not self.output or self.output.shape[0] != batch:
+            self.output.reset(shape=(batch,) + self.output_sample_shape)
+        self.init_array(self.input, self.output, self.weights, self.bias)
+
+    # -- weights view (honoring weights_transposed on the stored layout) ----
+    def _w(self, xp):
+        w = self.weights.mem if xp is np else self.weights.devmem
+        return w.T if self.weights_transposed else w
+
+    def _b(self, xp):
+        if not self.include_bias:
+            return None
+        return self.bias.mem if xp is np else self.bias.devmem
+
+    # -- compute ------------------------------------------------------------
+    def numpy_run(self) -> None:
+        out = linear.forward(np, self.input.mem, self._w(np), self._b(np),
+                             self.ACTIVATION)
+        self.output.map_invalidate()
+        self.output.mem = out.reshape((-1,) + self.output_sample_shape)
+
+    def xla_init(self) -> None:
+        act = self.ACTIVATION
+        shape = (-1,) + self.output_sample_shape
+
+        def fn(x, w, b):
+            return linear.forward(jnp, x, w, b, act).reshape(shape)
+
+        self._xla_fn = jax.jit(fn)
+
+    def xla_run(self) -> None:
+        self.input.unmap()
+        self.output.set_devmem(self._xla_fn(
+            self.input.devmem, self._w(jnp), self._b(jnp)))
+
+
+class All2AllTanh(All2All):
+    """FC + LeCun-scaled tanh (reference: All2AllTanh)."""
+    MAPPING = {"all2all_tanh"}
+    ACTIVATION = activations.TANH
+
+
+class All2AllRELU(All2All):
+    """FC + soft ReLU log(1+e^x) (reference: All2AllRELU)."""
+    MAPPING = {"all2all_relu"}
+    ACTIVATION = activations.RELU
+
+
+class All2AllStrictRELU(All2All):
+    """FC + max(0, x) (reference: All2AllStrictRELU)."""
+    MAPPING = {"all2all_str"}
+    ACTIVATION = activations.STRICT_RELU
+
+
+class All2AllSigmoid(All2All):
+    """FC + logistic sigmoid (reference: All2AllSigmoid)."""
+    MAPPING = {"all2all_sigmoid"}
+    ACTIVATION = activations.SIGMOID
+
+
+class All2AllSoftmax(All2All):
+    """FC + softmax, emitting per-row argmax into ``max_idx``
+    (reference: All2AllSoftmax with apply_exp kernel)."""
+
+    MAPPING = {"softmax"}
+    ACTIVATION = "softmax"
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.max_idx = Array()
+
+    def _common_init(self, **kwargs) -> None:
+        super()._common_init(**kwargs)
+        if not self.max_idx or self.max_idx.shape[0] != self.output.shape[0]:
+            self.max_idx.reset(shape=(self.output.shape[0],), dtype=np.int32)
+        self.init_array(self.max_idx)
+
+    def numpy_run(self) -> None:
+        y, idx = linear.softmax_forward(np, self.input.mem, self._w(np),
+                                        self._b(np))
+        self.output.map_invalidate()
+        self.output.mem = y.reshape((-1,) + self.output_sample_shape)
+        self.max_idx.map_invalidate()
+        self.max_idx.mem = idx.astype(np.int32)
+
+    def xla_init(self) -> None:
+        def fn(x, w, b):
+            y, idx = linear.softmax_forward(jnp, x, w, b)
+            return y, idx.astype(jnp.int32)
+
+        self._xla_fn = jax.jit(fn)
+
+    def xla_run(self) -> None:
+        self.input.unmap()
+        y, idx = self._xla_fn(self.input.devmem, self._w(jnp), self._b(jnp))
+        self.output.set_devmem(y)
+        self.max_idx.set_devmem(idx)

@@ -1,0 +1,164 @@
+"""Evaluators — rebuild of veles.znicz evaluator.py :: EvaluatorBase,
+EvaluatorSoftmax, EvaluatorMSE.
+
+Turn the last forward's output + labels/targets into ``err_output`` for the
+backward chain plus host-side metrics (``n_err``, confusion matrix, mse).
+
+Static-shape note (SURVEY.md §8 "dynamic epoch-tail batches"): the loader
+pads tail minibatches to the fixed minibatch size; evaluators mask rows
+beyond ``batch_size`` so padded samples contribute neither gradient nor
+metrics — the reference relied on the same per-sample masking semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+
+
+class EvaluatorBase(AcceleratedUnit):
+    """Common evaluator state (reference: evaluator.py :: EvaluatorBase)."""
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.output = Array()      # linked from last forward
+        self.err_output = Array()  # allocated here
+        #: inference mode: compute metrics only, no err_output needed
+        self.forward_mode = False
+
+    def _common_init(self, **kwargs) -> None:
+        if not self.err_output or self.err_output.shape != self.output.shape:
+            self.err_output.reset(shape=self.output.shape)
+        self.init_array(self.output, self.err_output)
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Softmax + cross-entropy evaluator (reference: EvaluatorSoftmax).
+
+    Consumes softmax probabilities ``output`` and integer ``labels``;
+    produces ``err_output = y - onehot(labels)`` (d CE/d logits), and
+    metrics: ``n_err`` (argmax mismatches), ``confusion_matrix``,
+    ``max_err_output_sum`` (largest |err| row-sum, a divergence canary).
+    """
+
+    def __init__(self, workflow=None, compute_confusion_matrix: bool = True,
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.labels = Array()   # linked from loader (minibatch_labels)
+        self.max_idx = Array()  # linked from All2AllSoftmax
+        self.compute_confusion_matrix = compute_confusion_matrix
+        self.n_err = 0
+        self.confusion_matrix = None
+        self.max_err_output_sum = 0.0
+
+    def _common_init(self, **kwargs) -> None:
+        super()._common_init(**kwargs)
+        n_classes = self.output.shape[1]
+        if self.compute_confusion_matrix:
+            self.confusion_matrix = np.zeros((n_classes, n_classes), np.int64)
+
+    @staticmethod
+    def _compute(xp, y, labels, max_idx, batch_size):
+        """Pure path shared by both backends; returns (err, n_err, sums)."""
+        n, c = y.shape
+        valid = (xp.arange(n) < batch_size)
+        onehot = (labels[:, None] == xp.arange(c)[None, :]).astype(y.dtype)
+        err = (y - onehot) * valid[:, None].astype(y.dtype)
+        n_err = xp.sum((max_idx != labels) & valid)
+        max_err_sum = xp.abs(err).sum(axis=1).max()
+        return err, n_err, max_err_sum
+
+    def numpy_run(self) -> None:
+        y = self.output.map_read()
+        labels = self.labels.map_read()
+        max_idx = self.max_idx.map_read() if self.max_idx else \
+            y.argmax(axis=1)
+        bs = self.current_batch_size(self.output)
+        err, n_err, max_err_sum = self._compute(np, y, labels, max_idx, bs)
+        self.err_output.map_invalidate()
+        self.err_output.mem = err
+        self.n_err = int(n_err)
+        self.max_err_output_sum = float(max_err_sum)
+        if self.compute_confusion_matrix:
+            np.add.at(self.confusion_matrix,
+                      (max_idx[:bs], labels[:bs]), 1)
+
+    def xla_init(self) -> None:
+        self._xla_fn = jax.jit(
+            lambda y, labels, max_idx, bs:
+            self._compute(jnp, y, labels, max_idx, bs))
+
+    def xla_run(self) -> None:
+        for arr in (self.output, self.labels):
+            arr.unmap()
+        max_idx = self.max_idx.devmem if self.max_idx else \
+            jnp.argmax(self.output.devmem, axis=1)
+        bs = self.current_batch_size(self.output)
+        err, n_err, max_err_sum = self._xla_fn(
+            self.output.devmem, self.labels.devmem, max_idx, bs)
+        self.err_output.set_devmem(err)
+        # metrics are host-side scalars (Decision consumes them in Python)
+        self.n_err = int(n_err)
+        self.max_err_output_sum = float(max_err_sum)
+        if self.compute_confusion_matrix:
+            idx = np.asarray(max_idx)[:bs]
+            lab = self.labels.map_read()[:bs]
+            np.add.at(self.confusion_matrix, (idx, lab), 1)
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error evaluator (reference: EvaluatorMSE).
+
+    err_output = output - target (masked); metrics: per-sample ``mse``
+    vector over the valid rows, batch ``rmse``; optional ``n_err`` when
+    ``labels``+``class_targets`` given (nearest-target classification, used
+    by the approximator samples).
+    """
+
+    def __init__(self, workflow=None, root_mse: bool = True, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.target = Array()  # linked from loader (minibatch_targets)
+        self.root_mse = root_mse
+        self.mse = 0.0
+        self.rmse = 0.0
+        self.n_err = 0
+
+    @staticmethod
+    def _compute(xp, y, target, batch_size):
+        n = y.shape[0]
+        valid = (xp.arange(n) < batch_size).astype(y.dtype)
+        diff = (y.reshape(n, -1) - target.reshape(n, -1)) * valid[:, None]
+        err = diff.reshape(y.shape)
+        sample_mse = (diff * diff).mean(axis=1)
+        mse = sample_mse.sum() / batch_size
+        return err, mse
+
+    def numpy_run(self) -> None:
+        y = self.output.map_read()
+        target = self.target.map_read()
+        bs = self.current_batch_size(self.output)
+        err, mse = self._compute(np, y, target, bs)
+        self.err_output.map_invalidate()
+        self.err_output.mem = err
+        self.mse = float(mse)
+        self.rmse = float(np.sqrt(self.mse))
+        self.n_err = self.mse  # Decision tracks mse for MSE workflows
+
+    def xla_init(self) -> None:
+        self._xla_fn = jax.jit(
+            lambda y, t, bs: self._compute(jnp, y, t, bs))
+
+    def xla_run(self) -> None:
+        for arr in (self.output, self.target):
+            arr.unmap()
+        bs = self.current_batch_size(self.output)
+        err, mse = self._xla_fn(self.output.devmem, self.target.devmem, bs)
+        self.err_output.set_devmem(err)
+        self.mse = float(mse)
+        self.rmse = float(np.sqrt(self.mse))
+        self.n_err = self.mse
