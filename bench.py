@@ -1,0 +1,60 @@
+"""Benchmark harness — emits ONE JSON line for the driver.
+
+Current flagship benchmark: fused training-step throughput (samples/sec)
+on the largest model the framework has landed; upgrades to the ImageNet
+AlexNet workflow (BASELINE.md config 3) as soon as the conv stack is in.
+``vs_baseline`` is 1.0 by convention: the reference published no numbers
+(BASELINE.json :: published == {}), so the driver-recorded history of this
+metric across rounds IS the baseline trend.
+"""
+
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_fc_train(batch: int = 1024, steps: int = 50, warmup: int = 5):
+    """Samples/sec of the fused FC training step on one chip."""
+    import numpy as np
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models.mnist_fc import build_fused
+
+    prng.seed_all(7)
+    w = build_fused(max_epochs=1, layers=(4096, 4096), minibatch_size=batch,
+                    n_train=2 * batch, n_valid=0)
+    w.initialize(device=TPUDevice())
+    step = w.step
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, batch).astype(np.int32)
+    mask = np.ones(batch, bool)
+    params = step._params
+    hyper = step.hyper_params()
+    for _ in range(warmup):
+        params, metrics = step._train_fn(params, hyper, x, labels, mask)
+    import jax
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, metrics = step._train_fn(params, hyper, x, labels, mask)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    sps = bench_fc_train()
+    print(json.dumps({
+        "metric": "mnist_fc4096_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Tier-3 tests: the fused/sharded training step (SURVEY.md §5 rebuild
+translation — multi-device SPMD on the virtual 8-device CPU mesh).
+
+- fused-vs-eager parity: one fused step produces the same weight update as
+  the per-unit eager chain (autograd-composed backward == hand-written
+  unit backward, through the full segment);
+- mesh invariance: training on an 8-device mesh matches 1-device within
+  float tolerance (psum math), and converges;
+- determinism on the mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import NumpyDevice, TPUDevice
+from znicz_tpu.models.mnist_fc import build_eager, build_fused
+from znicz_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+
+
+def test_fused_step_matches_eager_units():
+    """Same seed => same data, same init; run exactly one TRAIN minibatch
+    through both shapes and compare the updated weights."""
+    # eager: skip valid passes by using a train-only loader
+    prng.seed_all(77)
+    we = build_eager(max_epochs=1, n_valid=0, n_train=200, minibatch_size=50)
+    we.initialize(device=NumpyDevice())
+    we.loader.run()
+    for f in we.forwards:
+        f.run()
+    we.evaluator.run()
+    for gd in reversed(we.gds):
+        gd.run()
+
+    prng.seed_all(77)
+    wf = build_fused(max_epochs=1, n_valid=0, n_train=200, minibatch_size=50)
+    wf.initialize(device=TPUDevice())
+    wf.loader.run()
+    wf.step.run()
+    wf.step.sync_to_units()
+
+    for i, (fe, ff) in enumerate(zip(we.forwards, wf.forwards)):
+        np.testing.assert_allclose(
+            ff.weights.map_read(), fe.weights.map_read(),
+            rtol=1e-4, atol=1e-5, err_msg=f"layer {i} weights")
+        np.testing.assert_allclose(
+            ff.bias.map_read(), fe.bias.map_read(),
+            rtol=1e-4, atol=1e-5, err_msg=f"layer {i} bias")
+    # velocity buffers too (momentum state)
+    for i, (ge, gf) in enumerate(zip(we.gds, wf.gds)):
+        np.testing.assert_allclose(
+            gf.gradient_weights.map_read(), ge.gradient_weights.map_read(),
+            rtol=1e-4, atol=1e-5, err_msg=f"layer {i} velocity")
+
+
+def run_fused(seed, mesh, max_epochs=3):
+    prng.seed_all(seed)
+    w = build_fused(max_epochs=max_epochs, mesh=mesh)
+    w.initialize(device=TPUDevice())
+    w.run()
+    w.step.sync_to_units()
+    return w
+
+
+def test_fused_training_converges_on_8dev_mesh(cpu_devices):
+    mesh = data_parallel_mesh(8)
+    w = run_fused(31, mesh)
+    hist = w.decision.metrics_history
+    assert len(hist) == 3
+    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"]
+    assert w.decision.epoch_n_err_pt[1] < 15.0, hist
+
+
+def test_mesh_size_invariance(cpu_devices):
+    """DP over 8 devices is the same math as 1 device (sync SPMD: batch
+    split + psum == full-batch gradient), modulo float reduction order."""
+    w1 = run_fused(13, data_parallel_mesh(1), max_epochs=2)
+    w8 = run_fused(13, data_parallel_mesh(8), max_epochs=2)
+    np.testing.assert_allclose(
+        w8.forwards[0].weights.map_read(), w1.forwards[0].weights.map_read(),
+        rtol=1e-3, atol=1e-4)
+    assert [h["metric_validation"] for h in w1.decision.metrics_history] == \
+        [h["metric_validation"] for h in w8.decision.metrics_history]
+
+
+def test_fused_deterministic_on_mesh(cpu_devices):
+    w_a = run_fused(17, data_parallel_mesh(8), max_epochs=2)
+    w_b = run_fused(17, data_parallel_mesh(8), max_epochs=2)
+    np.testing.assert_array_equal(w_a.forwards[0].weights.map_read(),
+                                  w_b.forwards[0].weights.map_read())
+    assert w_a.decision.metrics_history == w_b.decision.metrics_history
+
+
+def test_make_mesh_axes(cpu_devices):
+    mesh = make_mesh({"data": 4, "model": 2})
+    assert mesh.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh({"data": 16})
+
+
+def test_lr_schedule_no_recompile(cpu_devices):
+    """Hyperparams are traced scalars: mutating gd.learning_rate between
+    steps must not retrigger compilation."""
+    prng.seed_all(5)
+    w = build_fused(max_epochs=1, mesh=data_parallel_mesh(8))
+    w.initialize(device=TPUDevice())
+    w.loader.run()
+    while int(w.loader.minibatch_class) != 2:
+        w.loader.run()
+    w.step.run()
+    compiled = w.step._train_fn._cache_size()
+    for gd in w.gds:
+        gd.learning_rate *= 0.5
+    w.loader.run()
+    w.step.run()
+    assert w.step._train_fn._cache_size() == compiled

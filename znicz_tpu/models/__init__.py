@@ -1,0 +1,4 @@
+"""Model zoo — rebuild of the reference's samples/ tree (SURVEY.md §3.1
+"Samples").  Each model module exposes builder functions consumed by tests,
+the benchmark harness and the CLI (``run(load, main)`` wrappers arrive with
+StandardWorkflow)."""

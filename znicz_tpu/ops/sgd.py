@@ -29,10 +29,11 @@ def update(xp, w, grad_sum, vel, learning_rate: float, weights_decay: float,
     minibatches divide by the *real* sample count).
     """
     g = grad_sum / batch_size
-    if weights_decay:
-        decay = (1.0 - l1_vs_l2) * w
-        if l1_vs_l2:
-            decay = decay + l1_vs_l2 * xp.sign(w)
-        g = g + weights_decay * decay
+    # branchless: hyperparams may be traced scalars inside the fused step
+    # (LR schedules mutate them without recompiling); the static-zero check
+    # only skips work when called eagerly with plain floats
+    if not (isinstance(weights_decay, (int, float)) and weights_decay == 0):
+        g = g + weights_decay * ((1.0 - l1_vs_l2) * w +
+                                 l1_vs_l2 * xp.sign(w))
     vel_new = gradient_moment * vel + learning_rate * g
     return w - vel_new, vel_new

@@ -1,0 +1,21 @@
+"""SPMD execution — the TPU-native replacement of the reference's
+distributed runtime (SURVEY.md §3.4, §4.2).
+
+The reference distributes training as an async parameter server over ZeroMQ
+(veles/server.py :: Server, veles/client.py :: Client): slaves compute
+weight deltas on their minibatches, the master applies them without a
+barrier.  Here the whole job protocol dissolves into synchronous SPMD: the
+accelerated segment of the control graph (forwards -> evaluator -> gradient
+updates) is traced ONCE into a pure step function and ``shard_map``-ped over
+a ``jax.sharding.Mesh`` with ``lax.psum`` gradient reduction riding ICI.
+The semantic change (async -> sync) is deliberate and improves
+reproducibility; convergence parity is pinned by the tier-2 tests.
+
+Host-side units (Loader / Decision / Snapshotter / plotters) stay exactly
+where the reference put them — outside the compiled step.
+"""
+
+from znicz_tpu.parallel.mesh import make_mesh, data_parallel_mesh
+from znicz_tpu.parallel.step import FusedTrainStep
+
+__all__ = ["make_mesh", "data_parallel_mesh", "FusedTrainStep"]

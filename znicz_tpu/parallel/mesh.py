@@ -1,0 +1,44 @@
+"""Device-mesh construction.
+
+Axis conventions (used across the framework; SURVEY.md §3.4 table):
+
+- ``data``  — batch (DP): gradients psum over it;
+- ``model`` — weight output-dim (TP): FC layers shard their (in, out)
+  weights on out; collectives are all-gathers XLA inserts;
+- ``seq``   — sequence/context (SP, ring attention extension).
+
+Multi-host: on a pod slice ``jax.devices()`` already spans hosts after
+``jax.distributed.initialize``; the same mesh code covers single-chip,
+one-host-8-chip, and multi-host — XLA routes collectives over ICI/DCN from
+the mesh topology.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: dict[str, int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with the given ``{axis: size}`` (insertion-ordered).
+    Total size must equal the device count used."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(list(axis_sizes.values())))
+    if n > len(devs):
+        raise ValueError(f"mesh wants {n} devices, have {len(devs)}")
+    shape = tuple(axis_sizes.values())
+    arr = np.array(devs[:n]).reshape(shape)
+    return Mesh(arr, tuple(axis_sizes.keys()))
+
+
+def data_parallel_mesh(n: Optional[int] = None,
+                       devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-axis ("data",) mesh over ``n`` devices (default: all)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n if n is not None else len(devs)
+    return make_mesh({"data": n}, devs)

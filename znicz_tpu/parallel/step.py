@@ -1,0 +1,254 @@
+"""FusedTrainStep — the traced-segment compiler (SURVEY.md §8 design
+stance).
+
+Takes the accelerated segment of an NN workflow (forwards -> evaluator ->
+gradient updates) and compiles it into ONE pure XLA program:
+
+    (params, hyper, x, labels/targets, mask) -> (params', metrics)
+
+``shard_map``-ped over a device mesh: the batch shards over the ``data``
+axis, params are replicated, gradient sums ride ``lax.psum`` over ICI —
+this is the rebuild of both (a) the reference's per-unit kernel-enqueue hot
+loop and (b) its entire ZeroMQ master-slave protocol (§4.2), which
+dissolves into the collective.
+
+The backward pass is ``jax.value_and_grad`` of the composed forward +
+evaluator loss: per-unit hand-written backward paths (units/gd.py) remain
+the eager/tier-1 semantics; the equivalence of the two is pinned by
+tests/test_units_fc.py::test_gd_matches_autograd and
+tests/test_parallel.py (fused-vs-eager parity).
+
+Per-layer hyperparameters (lr, weight decay, momentum) are traced scalars
+read from the gradient units at every call — LR schedule units mutate them
+without triggering recompilation.
+
+In the control graph, FusedTrainStep is one Unit replacing the whole
+segment: Repeater -> Loader -> FusedTrainStep -> Decision -> Repeater;
+Loader/Decision/Snapshotter stay host-side exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:                               # jax >= 0.8
+    from jax import shard_map
+except ImportError:                # older jax
+    from jax.experimental.shard_map import shard_map
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.ops import sgd
+from znicz_tpu.units.all2all import All2AllSoftmax
+from znicz_tpu.units.evaluator import EvaluatorMSE, EvaluatorSoftmax
+
+
+class FusedTrainStep(Unit):
+    """One-unit replacement for the accelerated segment of the graph."""
+
+    def __init__(self, workflow=None, forwards=None, evaluator=None,
+                 gds=None, loader=None, mesh: Optional[Mesh] = None,
+                 donate: bool = True, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.forwards = list(forwards or [])
+        self.evaluator = evaluator
+        #: gradient units in FORWARD order (gds[i] pairs forwards[i]);
+        #: suppliers of per-layer hyperparams + momentum buffers
+        self.gds = list(gds or [])
+        self.loader = loader
+        self.mesh = mesh
+        self.donate = donate
+        self._params = None
+        self._train_fn = None
+        self._eval_fn = None
+        # metrics the Decision links to (mirrors the evaluator's attrs)
+        self.n_err = 0
+        self.mse = 0.0
+        self.loss = 0.0
+
+    # -- parameter pytree ---------------------------------------------------
+    def gather_params(self):
+        """Build the params pytree from the unit Arrays, placed replicated
+        over the mesh — the same sharding the step outputs, so the jit
+        signature is stable from the first call."""
+        from jax.sharding import NamedSharding
+        rep = NamedSharding(self.mesh, P())
+        put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
+        params = []
+        for fwd, gd in zip(self.forwards, self.gds):
+            leaf = {k: put(arr.map_read())
+                    for k, arr in fwd.param_arrays().items()}
+            leaf["vw"] = put(np.zeros_like(fwd.weights.map_read())) \
+                if not gd.gradient_weights \
+                else put(gd.gradient_weights.map_read())
+            if "b" in leaf:
+                leaf["vb"] = put(np.zeros_like(fwd.bias.map_read())) \
+                    if not gd.gradient_bias \
+                    else put(gd.gradient_bias.map_read())
+            params.append(leaf)
+        return params
+
+    def hyper_params(self):
+        """Per-layer hyperparams, read fresh each call (traced scalars)."""
+        return [
+            {"lr": float(gd.learning_rate), "wd": float(gd.weights_decay),
+             "l1": float(gd.l1_vs_l2), "mom": float(gd.gradient_moment),
+             "lr_b": float(gd.learning_rate_bias),
+             "wd_b": float(gd.weights_decay_bias),
+             "mom_b": float(gd.gradient_moment_bias)}
+            for gd in self.gds
+        ]
+
+    def sync_to_units(self) -> None:
+        """Write the device params back into the unit Arrays (snapshot /
+        inspection path; the hot loop never does this)."""
+        for fwd, gd, leaf in zip(self.forwards, self.gds, self._params):
+            fwd.weights.set_devmem(leaf["w"])
+            gd.gradient_weights.set_devmem(leaf["vw"])
+            if "b" in leaf:
+                fwd.bias.set_devmem(leaf["b"])
+                gd.gradient_bias.set_devmem(leaf["vb"])
+
+    # -- forward / loss composition -----------------------------------------
+    def _forward_chain(self, params, x, train: bool):
+        """Compose the forwards; returns pre-softmax logits when the last
+        layer is All2AllSoftmax (loss uses log_softmax directly)."""
+        last = len(self.forwards) - 1
+        logits_tail = isinstance(self.forwards[last], All2AllSoftmax) and \
+            isinstance(self.evaluator, EvaluatorSoftmax)
+        for i, (fwd, p) in enumerate(zip(self.forwards, params)):
+            if i == last and logits_tail:
+                x = fwd.xla_apply_linear(p, x)
+            else:
+                x = fwd.xla_apply(p, x)
+        return x, logits_tail
+
+    def _loss_and_metrics(self, out, logits_tail, labels, mask):
+        """Masked loss-sum + metric sums over the local shard."""
+        fmask = mask.astype(out.dtype)
+        if isinstance(self.evaluator, EvaluatorSoftmax):
+            if logits_tail:
+                logp = jax.nn.log_softmax(out, axis=1)
+            else:
+                logp = jnp.log(jnp.clip(out, 1e-30, None))
+            n = out.shape[0]
+            picked = logp[jnp.arange(n), labels]
+            loss = -(picked * fmask).sum()
+            pred = out.argmax(axis=1)
+            n_err = ((pred != labels) & mask).sum()
+            return loss, {"loss": loss, "n_err": n_err}
+        if isinstance(self.evaluator, EvaluatorMSE):
+            n = out.shape[0]
+            diff = (out.reshape(n, -1) -
+                    labels.reshape(n, -1)) * fmask[:, None]
+            loss = 0.5 * (diff * diff).sum()
+            mse_sum = (diff * diff).mean(axis=1).sum()
+            return loss, {"loss": loss, "mse_sum": mse_sum}
+        raise TypeError(f"unsupported evaluator {type(self.evaluator)}")
+
+    # -- compiled step bodies ------------------------------------------------
+    def _local_train(self, params, hyper, x, labels, mask):
+        # differentiate only the trainable leaves — the momentum buffers
+        # vw/vb never enter the loss and would otherwise get same-shaped
+        # zero cotangents materialized every step
+        trainable = [{k: v for k, v in leaf.items() if k in ("w", "b")}
+                     for leaf in params]
+
+        def loss_fn(ps):
+            out, logits_tail = self._forward_chain(ps, x, train=True)
+            loss, metrics = self._loss_and_metrics(
+                out, logits_tail, labels, mask)
+            # the gradient plane: differentiating through this psum makes AD
+            # itself produce the globally-summed gradient of the replicated
+            # params — one ICI collective replacing the reference's whole
+            # ZeroMQ weight-shipping protocol.  (Do NOT psum the grads again
+            # outside: replicated-input cotangents are already reduced.)
+            return jax.lax.psum(loss, "data"), jax.lax.psum(metrics, "data")
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        bs = jax.lax.psum(mask.sum(), "data")
+        metrics["bs"] = bs
+        new_params = []
+        for leaf, grad, h in zip(params, grads, hyper):
+            new = dict(leaf)
+            new["w"], new["vw"] = sgd.update(
+                jnp, leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
+                h["l1"], h["mom"], bs)
+            if "b" in leaf:
+                new["b"], new["vb"] = sgd.update(
+                    jnp, leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
+                    h["wd_b"], h["l1"], h["mom_b"], bs)
+            new_params.append(new)
+        return new_params, metrics
+
+    def _local_eval(self, params, x, labels, mask):
+        out, logits_tail = self._forward_chain(params, x, train=False)
+        _, metrics = self._loss_and_metrics(out, logits_tail, labels, mask)
+        metrics = jax.lax.psum(metrics, "data")
+        metrics["bs"] = jax.lax.psum(mask.sum(), "data")
+        return metrics
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, device=None, **kwargs) -> None:
+        # the step subsumes the segment units: they are not in the control
+        # graph, so initialize them here (weights allocated + filled) before
+        # gathering the params pytree
+        for unit in (*self.forwards, self.evaluator, *self.gds):
+            if unit is not None and not unit.initialized:
+                unit.initialize(device=device, **kwargs)
+                unit.initialized = True
+        if self.mesh is None:
+            self.mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        n_data = self.mesh.shape["data"]
+        if self.loader is not None and \
+                self.loader.max_minibatch_size % n_data != 0:
+            raise ValueError(
+                f"minibatch {self.loader.max_minibatch_size} not divisible "
+                f"by data-mesh size {n_data}")
+        self._params = self.gather_params()
+        rep, sh = P(), P("data")
+        train = shard_map(self._local_train, mesh=self.mesh,
+                          in_specs=(rep, rep, sh, sh, sh),
+                          out_specs=(rep, rep))
+        evalf = shard_map(self._local_eval, mesh=self.mesh,
+                          in_specs=(rep, sh, sh, sh),
+                          out_specs=rep)
+        donate = (0,) if self.donate else ()
+        self._train_fn = jax.jit(train, donate_argnums=donate)
+        self._eval_fn = jax.jit(evalf)
+        self.initialized = True
+
+    # -- per-minibatch control callback -------------------------------------
+    def run(self) -> None:
+        loader = self.loader
+        x = loader.minibatch_data.mem
+        if isinstance(self.evaluator, EvaluatorMSE):
+            labels = loader.minibatch_targets.mem
+        else:
+            labels = loader.minibatch_labels.mem
+        mask = loader.minibatch_indices.mem >= 0
+        if int(loader.minibatch_class) == TRAIN:
+            self._params, metrics = self._train_fn(
+                self._params, self.hyper_params(), x, labels, mask)
+        else:
+            metrics = self._eval_fn(self._params, x, labels, mask)
+        # host-side scalars for the Decision (one device sync per minibatch;
+        # the deferred-metrics mode lands with the bench work)
+        bs = float(metrics["bs"])
+        self.loss = float(metrics["loss"])
+        if "n_err" in metrics:
+            self.n_err = int(metrics["n_err"])
+        if "mse_sum" in metrics:
+            self.mse = float(metrics["mse_sum"]) / max(bs, 1.0)
+
+    def stop(self) -> None:
+        if self._params is not None:
+            self.sync_to_units()

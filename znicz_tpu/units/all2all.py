@@ -58,6 +58,26 @@ class All2All(Forward):
             return None
         return self.bias.mem if xp is np else self.bias.devmem
 
+    # -- fused-step protocol (znicz_tpu.parallel.step) ----------------------
+    def param_arrays(self) -> dict:
+        """Trainable Arrays contributed to the fused step's params pytree."""
+        out = {"w": self.weights}
+        if self.include_bias:
+            out["b"] = self.bias
+        return out
+
+    def xla_apply(self, p: dict, x):
+        """Pure jnp forward over a params leaf-dict (traced once into the
+        fused training step)."""
+        return activations.forward(jnp, self.ACTIVATION,
+                                   self.xla_apply_linear(p, x))
+
+    def xla_apply_linear(self, p: dict, x):
+        """Pre-activation part only (the fused softmax+CE path composes
+        log_softmax into the loss for numerical stability)."""
+        w = p["w"].T if self.weights_transposed else p["w"]
+        return linear.forward(jnp, x, w, p.get("b"), activations.LINEAR)
+
     # -- compute ------------------------------------------------------------
     def numpy_run(self) -> None:
         out = linear.forward(np, self.input.mem, self._w(np), self._b(np),
@@ -114,6 +134,9 @@ class All2AllSoftmax(All2All):
     def __init__(self, workflow=None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.max_idx = Array()
+
+    def xla_apply(self, p: dict, x):
+        return jax.nn.softmax(self.xla_apply_linear(p, x), axis=1)
 
     def _common_init(self, **kwargs) -> None:
         super()._common_init(**kwargs)
