@@ -1,0 +1,228 @@
+"""Tier-1 tests for the conv/pooling/LRN op layer: numpy-im2col oracle vs
+XLA-native lowering parity + numeric-derivative checks (SURVEY.md §5 —
+the rebuild of the reference's ocl-vs-numpy kernel tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from znicz_tpu.ops import activations, conv as conv_ops, lrn as lrn_ops
+from znicz_tpu.ops import pooling as pool_ops
+
+GEOMS = [
+    # (h, w, cin, cout, ky, kx, sliding, padding)
+    (6, 7, 3, 4, 3, 3, (1, 1), (0, 0, 0, 0)),
+    (8, 8, 2, 5, 3, 2, (2, 2), (1, 1, 1, 1)),
+    (5, 9, 1, 2, 2, 4, (1, 3), (2, 0, 1, 3)),
+]
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_conv_forward_numpy_vs_xla(geom):
+    h, w, cin, cout, ky, kx, sl, pad = geom
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, h, w, cin)).astype(np.float32)
+    wt = rng.normal(size=(ky, kx, cin, cout)).astype(np.float32) * 0.3
+    b = rng.normal(size=(cout,)).astype(np.float32)
+    want = conv_ops.forward(np, x, wt, b, sl, pad, activations.TANH)
+    got = np.asarray(conv_ops.forward(jnp, jnp.asarray(x), jnp.asarray(wt),
+                                      jnp.asarray(b), sl, pad,
+                                      activations.TANH))
+    assert want.shape == got.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_conv_backward_numpy_vs_xla(geom):
+    h, w, cin, cout, ky, kx, sl, pad = geom
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, h, w, cin)).astype(np.float32)
+    wt = rng.normal(size=(ky, kx, cin, cout)).astype(np.float32) * 0.3
+    y = conv_ops.forward(np, x, wt, None, sl, pad, activations.LINEAR)
+    err = rng.normal(size=y.shape).astype(np.float32)
+    ein_np, gw_np, gb_np = conv_ops.backward(
+        np, x, y, wt, err, sl, pad, activations.LINEAR)
+    ein_x, gw_x, gb_x = conv_ops.backward(
+        jnp, jnp.asarray(x), jnp.asarray(y), jnp.asarray(wt),
+        jnp.asarray(err), sl, pad, activations.LINEAR)
+    np.testing.assert_allclose(np.asarray(ein_x), ein_np, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw_x), gw_np, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb_x), gb_np, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_backward_matches_numeric():
+    """Finite-difference check of the numpy oracle (err_input and grad_w)."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 5, 5, 2)).astype(np.float64)
+    wt = rng.normal(size=(3, 3, 2, 3)).astype(np.float64) * 0.4
+    sl, pad = (2, 2), (1, 1, 1, 1)
+    err = rng.normal(size=conv_ops.forward(np, x, wt, None, sl, pad).shape)
+
+    def loss_x(xx):
+        return (conv_ops.forward(np, xx, wt, None, sl, pad) * err).sum()
+
+    def loss_w(ww):
+        return (conv_ops.forward(np, x, ww, None, sl, pad) * err).sum()
+
+    ein, gw, _ = conv_ops.backward(np, x, None, wt, err, sl, pad,
+                                   activations.LINEAR,
+                                   activation_applied=False)
+    eps = 1e-6
+    for arr, grad, loss in ((x, ein, loss_x), (wt, gw, loss_w)):
+        flat = arr.ravel()
+        for i in rng.choice(flat.size, 12, replace=False):
+            old = flat[i]
+            flat[i] = old + eps
+            up = loss(arr)
+            flat[i] = old - eps
+            down = loss(arr)
+            flat[i] = old
+            np.testing.assert_allclose(grad.ravel()[i], (up - down) / (2 * eps),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_ref_weights_roundtrip():
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(3, 2, 4, 5)).astype(np.float32)
+    ref = conv_ops.ref_weights_view(w)
+    assert ref.shape == (5, 3 * 2 * 4)
+    np.testing.assert_array_equal(conv_ops.from_ref_weights(ref, 3, 2, 4), w)
+
+
+POOL_GEOMS = [
+    (6, 6, 2, 2, (2, 2)),     # exact tiling
+    (7, 5, 3, 2, (2, 2)),     # partial border windows
+    (5, 5, 2, 2, (1, 1)),     # overlapping
+]
+
+
+@pytest.mark.parametrize("geom", POOL_GEOMS)
+@pytest.mark.parametrize("use_abs", [False, True])
+def test_max_pooling_numpy_vs_xla(geom, use_abs):
+    h, w, ky, kx, sl = geom
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, h, w, 3)).astype(np.float32)
+    y_np, off_np = pool_ops.max_forward(np, x, ky, kx, *sl, use_abs=use_abs)
+    y_x, off_x = pool_ops.max_forward(jnp, jnp.asarray(x), ky, kx, *sl,
+                                      use_abs=use_abs)
+    np.testing.assert_allclose(np.asarray(y_x), y_np, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(off_x), off_np)
+    # winner offsets point at elements with the winning value
+    n, oh, ow, c = y_np.shape
+    flat = x.reshape(2, -1, 3)
+    for ni in range(n):
+        for ci in range(c):
+            picked = flat[ni, off_np[ni, :, :, ci].ravel(), ci]
+            np.testing.assert_allclose(picked, y_np[ni, :, :, ci].ravel())
+
+
+@pytest.mark.parametrize("geom", POOL_GEOMS)
+def test_avg_pooling_numpy_vs_xla_and_border_counts(geom):
+    h, w, ky, kx, sl = geom
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(2, h, w, 3)).astype(np.float32)
+    y_np = pool_ops.avg_forward(np, x, ky, kx, *sl)
+    y_x = pool_ops.avg_forward(jnp, jnp.asarray(x), ky, kx, *sl)
+    np.testing.assert_allclose(np.asarray(y_x), y_np, rtol=1e-5, atol=1e-6)
+    # ones stay ones even in clipped border windows (count-correct divide)
+    ones = np.ones((1, h, w, 1), np.float32)
+    np.testing.assert_allclose(pool_ops.avg_forward(np, ones, ky, kx, *sl),
+                               1.0, rtol=1e-6)
+
+
+def test_max_pool_scatter_roundtrip():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)
+    y, off = pool_ops.max_forward(np, x, 2, 2, 2, 2)
+    err = rng.normal(size=y.shape).astype(np.float32)
+    ein_np = pool_ops.scatter_backward(np, err, off, x.shape)
+    ein_x = pool_ops.scatter_backward(jnp, jnp.asarray(err),
+                                      jnp.asarray(off), x.shape)
+    np.testing.assert_allclose(np.asarray(ein_x), ein_np, rtol=1e-6)
+    assert abs(ein_np.sum() - err.sum()) < 1e-4  # scatter conserves mass
+
+
+def test_avg_pool_backward_numpy_vs_xla():
+    rng = np.random.default_rng(7)
+    in_shape = (2, 7, 5, 3)
+    err = rng.normal(size=(2, 3, 3, 3)).astype(np.float32)
+    ein_np = pool_ops.avg_backward(np, err, in_shape, 3, 2, 2, 2)
+    ein_x = pool_ops.avg_backward(jnp, jnp.asarray(err), in_shape, 3, 2, 2, 2)
+    np.testing.assert_allclose(np.asarray(ein_x), ein_np, rtol=1e-5,
+                               atol=1e-6)
+    assert abs(ein_np.sum() - err.sum()) < 1e-4
+
+
+def test_stochastic_pooling_determinism_and_expectation():
+    rng = np.random.default_rng(8)
+    x = np.abs(rng.normal(size=(2, 6, 6, 2))).astype(np.float32)
+    u = rng.uniform(size=(2, 3, 3, 2)).astype(np.float32)
+    y1, off1 = pool_ops.stochastic_forward(np, x, 2, 2, 2, 2, u, False, True)
+    y2, off2 = pool_ops.stochastic_forward(np, x, 2, 2, 2, 2, u, False, True)
+    np.testing.assert_array_equal(y1, y2)       # same uniforms => same sample
+    np.testing.assert_array_equal(off1, off2)
+    yj, _ = pool_ops.stochastic_forward(jnp, jnp.asarray(x), 2, 2, 2, 2,
+                                        jnp.asarray(u), False, True)
+    np.testing.assert_allclose(np.asarray(yj), y1, rtol=1e-6)
+    # inference mode = expectation, between min and max of each window
+    ye, off = pool_ops.stochastic_forward(np, x, 2, 2, 2, 2, None, False,
+                                          False)
+    assert off is None
+    ymax, _ = pool_ops.max_forward(np, x, 2, 2, 2, 2)
+    assert (ye <= ymax + 1e-6).all()
+    assert (ye >= 0).all()
+
+
+def test_stochastic_pooling_zero_total_window_in_bounds():
+    """All-nonpositive windows must sample an in-bounds element (the window
+    origin), so the backward scatter never indexes padded slots."""
+    x = -np.ones((1, 3, 3, 1), np.float32)
+    u = np.full((1, 2, 2, 1), 0.7, np.float32)
+    y, off = pool_ops.stochastic_forward(np, x, 2, 2, 2, 2, u, False, True)
+    assert (off < 9).all()
+    np.testing.assert_allclose(y, -1.0)
+    # backward scatter works on these offsets
+    ein = pool_ops.scatter_backward(np, np.ones_like(y), off, x.shape)
+    assert ein.shape == x.shape and abs(ein.sum() - 4.0) < 1e-6
+
+
+def test_lrn_forward_backward_parity_and_numeric():
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(2, 4, 4, 8)).astype(np.float64)
+    args = (1e-4, 0.75, 2.0, 5)
+    y_np = lrn_ops.forward(np, x, *args)
+    y_x = lrn_ops.forward(jnp, jnp.asarray(x), *args)
+    np.testing.assert_allclose(np.asarray(y_x), y_np, rtol=1e-5, atol=1e-6)
+    err = rng.normal(size=x.shape)
+    ein_np = lrn_ops.backward(np, x, err, *args)
+    ein_x = lrn_ops.backward(jnp, jnp.asarray(x), jnp.asarray(err), *args)
+    np.testing.assert_allclose(np.asarray(ein_x), ein_np, rtol=1e-5,
+                               atol=1e-6)
+    # numeric check (exact derivative claim, SURVEY.md §3.2 LRN bwd)
+    eps = 1e-6
+    flat = x.ravel()
+    for i in rng.choice(flat.size, 10, replace=False):
+        old = flat[i]
+        flat[i] = old + eps
+        up = (lrn_ops.forward(np, x, *args) * err).sum()
+        flat[i] = old - eps
+        down = (lrn_ops.forward(np, x, *args) * err).sum()
+        flat[i] = old
+        np.testing.assert_allclose(ein_np.ravel()[i], (up - down) / (2 * eps),
+                                   rtol=1e-4, atol=1e-7)
+
+
+def test_lrn_autograd_matches_hand_backward():
+    """The fused step differentiates the jnp forward with AD; pin that AD
+    and the hand-written exact backward agree."""
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.normal(size=(2, 3, 3, 6)).astype(np.float32))
+    err = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+    args = (1e-4, 0.75, 2.0, 5)
+    _, vjp = jax.vjp(lambda xx: lrn_ops.forward(jnp, xx, *args), x)
+    (ein_ad,) = vjp(err)
+    ein_hand = lrn_ops.backward(jnp, x, err, *args)
+    np.testing.assert_allclose(np.asarray(ein_ad), np.asarray(ein_hand),
+                               rtol=1e-4, atol=1e-5)

@@ -23,10 +23,17 @@ TANH = "tanh"
 RELU = "relu"
 STRICT_RELU = "strict_relu"
 SIGMOID = "sigmoid"
+#: standalone-unit extras (reference: activation.{cl,cu} — SURVEY.md §3.1;
+#: exact formulas reconstructed, marked [MED] there)
+LOG = "log"            # y = log(x + sqrt(x^2+1))  (asinh — defined everywhere)
+SINCOS = "sincos"      # even flat indices cos(x), odd sin(x)
+TANHLOG = "tanhlog"    # LeCun tanh below |x|<=d, log-growth tail above
 
 #: LeCun tanh constants (reference: defines.cl :: 1.7159 * tanh(2/3 x))
 TANH_A = 1.7159
 TANH_B = 2.0 / 3.0
+#: tanh->log switchover point for TANHLOG
+TANHLOG_D = 1.0
 
 
 def forward(xp, name: str, v):
@@ -42,7 +49,39 @@ def forward(xp, name: str, v):
         return xp.maximum(v, 0)
     if name == SIGMOID:
         return 1.0 / (1.0 + xp.exp(-v))
+    if name == LOG:
+        return xp.log(v + xp.sqrt(v * v + 1.0))
+    if name == SINCOS:
+        flat = v.reshape(v.shape[0], -1)
+        idx = xp.arange(flat.shape[1]) % 2
+        out = xp.where(idx[None, :] == 0, xp.cos(flat), xp.sin(flat))
+        return out.reshape(v.shape)
+    if name == TANHLOG:
+        d = TANHLOG_D
+        knee = TANH_A * xp.tanh(TANH_B * d)
+        tail = xp.sign(v) * (knee + xp.log(xp.maximum(xp.abs(v), d) / d))
+        return xp.where(xp.abs(v) <= d, TANH_A * xp.tanh(TANH_B * v), tail)
     raise ValueError(f"unknown activation {name!r}")
+
+
+def derivative_from_input(xp, name: str, x, y):
+    """d(act)/dx for activations whose derivative needs the *input* —
+    the standalone activation units link both sides (reference:
+    ActivationBackward has input + output attrs)."""
+    if name == LOG:
+        return 1.0 / xp.sqrt(x * x + 1.0)
+    if name == SINCOS:
+        flat = x.reshape(x.shape[0], -1)
+        idx = xp.arange(flat.shape[1]) % 2
+        out = xp.where(idx[None, :] == 0, -xp.sin(flat), xp.cos(flat))
+        return out.reshape(x.shape)
+    if name == TANHLOG:
+        d = TANHLOG_D
+        t = TANH_A * xp.tanh(TANH_B * x)
+        dtanh = TANH_B * (TANH_A - t * t / TANH_A)
+        return xp.where(xp.abs(x) <= d, dtanh,
+                        1.0 / xp.maximum(xp.abs(x), d))
+    return derivative_from_output(xp, name, y)
 
 
 def derivative_from_output(xp, name: str, y):

@@ -1,0 +1,155 @@
+"""2-D convolution forward/backward — rebuild of the reference's
+implicit-im2col conv kernels (conv/forward.{cl,cu},
+gradient_descent_conv/*.{cl,cu} — SURVEY.md §3.2).
+
+Layouts (TPU-first design decisions):
+- activations are **NHWC** (the reference is NHWC too — SURVEY.md §3.1 Conv);
+- weights are stored **HWIO** ``(ky, kx, c_in, n_kernels)`` — the layout
+  ``lax.conv_general_dilated`` consumes directly, so the jnp path is a
+  single XLA conv that Mosaic tiles onto the MXU.  The reference stores
+  ``(n_kernels, ky*kx*c)``; ``ref_weights_view`` converts for import/export.
+
+Geometry follows the reference: ``sliding=(sy, sx)`` strides and an explicit
+``padding=(top, bottom, left, right)`` 4-tuple (ints and 2-tuples are
+normalized by :func:`normalize_geometry`).
+
+The numpy path is the im2col oracle (materialized patch tensor + GEMM —
+exactly what the reference kernels do in shared memory); the jnp path uses
+XLA's native conv and, for the backward, ``jax.vjp`` of the forward — XLA
+emits the transposed-conv / patch-GEMM pair itself, which on TPU beats any
+hand-scheduled col2im (SURVEY.md §3.2 "TPU-native mapping").
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from znicz_tpu.ops import activations
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def normalize_geometry(kx: int, ky: int, sliding, padding
+                       ) -> Tuple[int, int, int, int, int, int, int, int]:
+    """Returns ``(ky, kx, sy, sx, pt, pb, pl, pr)``."""
+    if isinstance(sliding, int):
+        sy = sx = sliding
+    else:
+        sy, sx = sliding
+    if isinstance(padding, int):
+        pt = pb = pl = pr = padding
+    elif len(padding) == 2:
+        (pt, pl) = padding
+        pb, pr = pt, pl
+    else:
+        pt, pb, pl, pr = padding
+    return ky, kx, sy, sx, pt, pb, pl, pr
+
+
+def out_size(size: int, k: int, stride: int, pad0: int, pad1: int) -> int:
+    return (size + pad0 + pad1 - k) // stride + 1
+
+
+def im2col(xp, x, ky, kx, sy, sx, pt, pb, pl, pr):
+    """Patch tensor ``(n, oh, ow, ky, kx, c)`` — works for numpy and traced
+    jnp alike (static python loop over the kernel window)."""
+    n, h, w, c = x.shape
+    oh = out_size(h, ky, sy, pt, pb)
+    ow = out_size(w, kx, sx, pl, pr)
+    xpad = xp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    rows = []
+    for iy in range(ky):
+        cols = []
+        for ix in range(kx):
+            cols.append(xpad[:, iy:iy + oh * sy:sy, ix:ix + ow * sx:sx, :])
+        rows.append(xp.stack(cols, axis=3))
+    return xp.stack(rows, axis=3), oh, ow  # (n, oh, ow, ky, kx, c)
+
+
+def col2im(xp, cols_err, x_shape, ky, kx, sy, sx, pt, pb, pl, pr):
+    """Scatter patch-gradients back onto the input — the reference's
+    hardest kernel (overlapping atomics col2im); here an overlap-add."""
+    n, h, w, c = x_shape
+    oh, ow = cols_err.shape[1], cols_err.shape[2]
+    padded = np.zeros((n, h + pt + pb, w + pl + pr, c), cols_err.dtype)
+    for iy in range(ky):
+        for ix in range(kx):
+            padded[:, iy:iy + oh * sy:sy, ix:ix + ow * sx:sx, :] += \
+                cols_err[:, :, :, iy, ix, :]
+    return padded[:, pt:pt + h, pl:pl + w, :]
+
+
+def forward_linear(xp, x, weights, bias, sliding, padding):
+    """Pre-activation conv: NHWC x  *  HWIO w  (+ b)."""
+    ky, kx = weights.shape[0], weights.shape[1]
+    ky, kx, sy, sx, pt, pb, pl, pr = normalize_geometry(
+        kx, ky, sliding, padding)
+    if xp is np:
+        cols, oh, ow = im2col(np, x, ky, kx, sy, sx, pt, pb, pl, pr)
+        n = x.shape[0]
+        v = cols.reshape(n * oh * ow, -1) @ weights.reshape(-1,
+                                                            weights.shape[3])
+        v = v.reshape(n, oh, ow, weights.shape[3])
+    else:
+        v = lax.conv_general_dilated(
+            x, weights, window_strides=(sy, sx),
+            padding=((pt, pb), (pl, pr)), dimension_numbers=_DIMNUMS)
+    if bias is not None:
+        v = v + bias
+    return v
+
+
+def forward(xp, x, weights, bias, sliding, padding,
+            activation: str = activations.LINEAR):
+    return activations.forward(
+        xp, activation, forward_linear(xp, x, weights, bias, sliding, padding))
+
+
+def backward(xp, x, y, weights, err_output, sliding, padding,
+             activation: str, activation_applied: bool = True):
+    """Returns ``(err_input, grad_weights, grad_bias)``; gradients are
+    summed over the batch (normalization happens in the SGD update —
+    reference semantics, znicz_tpu.ops.sgd)."""
+    ky, kx = weights.shape[0], weights.shape[1]
+    ky, kx, sy, sx, pt, pb, pl, pr = normalize_geometry(
+        kx, ky, sliding, padding)
+    if activation_applied:
+        err_v = activations.backward(xp, activation, y, err_output)
+    else:
+        err_v = err_output
+    if xp is np:
+        cols, oh, ow = im2col(np, x, ky, kx, sy, sx, pt, pb, pl, pr)
+        n = x.shape[0]
+        e = err_v.reshape(n * oh * ow, -1)
+        grad_w = (cols.reshape(n * oh * ow, -1).T @ e).reshape(weights.shape)
+        cols_err = (e @ weights.reshape(-1, weights.shape[3]).T).reshape(
+            n, oh, ow, ky, kx, x.shape[3])
+        err_input = col2im(np, cols_err, x.shape, ky, kx, sy, sx,
+                           pt, pb, pl, pr)
+    else:
+        fwd = lambda xx, ww: forward_linear(      # noqa: E731
+            jnp, xx, ww, None, (sy, sx), (pt, pb, pl, pr))
+        _, vjp = jax.vjp(fwd, x, weights)
+        err_input, grad_w = vjp(err_v)
+    grad_b = err_v.sum(axis=(0, 1, 2))
+    return err_input, grad_w, grad_b
+
+
+def ref_weights_view(w_hwio):
+    """HWIO -> the reference's ``(n_kernels, ky*kx*c)`` matrix view
+    (export/interop only — never in the hot loop)."""
+    ky, kx, c, n = w_hwio.shape
+    return np.transpose(np.asarray(w_hwio), (3, 0, 1, 2)).reshape(n, -1)
+
+
+def from_ref_weights(w_ref, ky: int, kx: int, c: int):
+    """Inverse of :func:`ref_weights_view`."""
+    n = w_ref.shape[0]
+    return np.transpose(np.asarray(w_ref).reshape(n, ky, kx, c),
+                        (1, 2, 3, 0))

@@ -1,0 +1,43 @@
+"""Local response normalization (cross-map, AlexNet-style) — rebuild of the
+reference's normalization.{cl,cu} kernels (SURVEY.md §3.2: "cross-map
+sliding sums fwd; exact-derivative bwd").
+
+    d_i = k + alpha * sum_{j in window(i)} x_j^2
+    y_i = x_i * d_i^(-beta)
+
+The channel window is ``n`` channels centred on i (clipped at the ends).
+Backward is the exact derivative, not an approximation:
+
+    dL/dx_j = e_j d_j^(-beta)
+              - 2 alpha beta x_j * sum_{i: j in window(i)} e_i x_i d_i^(-beta-1)
+
+and because the window is symmetric the inverse-neighbourhood sum is the
+same sliding window applied to ``t = e * x * d^(-beta-1)``.
+"""
+
+from __future__ import annotations
+
+
+def window_sum(xp, x, n: int):
+    """Sliding sum over the channel (last) axis, window ``n`` centred,
+    zero-padded — static python loop, fuses under XLA."""
+    half = n // 2
+    pad = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+    xpad = xp.pad(x, pad)
+    c = x.shape[-1]
+    acc = xpad[..., 0:c]
+    for i in range(1, n):
+        acc = acc + xpad[..., i:i + c]
+    return acc
+
+
+def forward(xp, x, alpha: float, beta: float, k: float, n: int):
+    d = k + alpha * window_sum(xp, x * x, n)
+    return x * d ** (-beta)
+
+
+def backward(xp, x, err_output, alpha: float, beta: float, k: float, n: int):
+    d = k + alpha * window_sum(xp, x * x, n)
+    t = err_output * x * d ** (-beta - 1.0)
+    return err_output * d ** (-beta) - 2.0 * alpha * beta * x * window_sum(
+        xp, t, n)

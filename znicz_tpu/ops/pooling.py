@@ -1,0 +1,161 @@
+"""Window pooling forward/backward — rebuild of the reference's
+pooling.{cl,cu} + gradient_descent_pooling kernels (SURVEY.md §3.2).
+
+Semantics kept from the reference:
+- geometry ``kx/ky`` window, ``sliding`` stride; **partial border windows
+  are included** (output size = ceil((in - k)/stride) + 1, window clipped
+  at the edge) — znicz pooling covers the whole input;
+- max variants record the winner's flat ``(row*W + col)`` offset per
+  ``(n, oy, ox, c)`` into ``input_offset`` for the backward scatter;
+- avg divides by the *actual* (clipped) window element count;
+- stochastic variants sample the winner with probability proportional to
+  the (abs) activation — Zeiler&Fergus stochastic pooling, which the
+  reference implements with its device xorshift PRNG; in ``forward_mode``
+  (inference) they fall back to the probability-weighted expectation.
+
+One implementation serves both backends: the patch tensor is built by a
+static python loop over the window (numpy slices / XLA-fused slices).  The
+fused training path differentiates through the jnp forward with autograd,
+so the recorded offsets are only used by the eager per-unit backward —
+exactly the role the reference's ``input_offset`` plays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def pool_out_size(size: int, k: int, stride: int) -> int:
+    """ceil((size - k)/stride) + 1, but never losing the first window."""
+    if size <= k:
+        return 1
+    return -(-(size - k) // stride) + 1
+
+
+def window_counts(h, w, ky, kx, sy, sx):
+    """Static window geometry: ``(valid, count)`` where valid (oh, ow, ky*kx)
+    masks in-bounds window elements and count (oh, ow, 1) is their number.
+    Pure numpy — computed once from shapes, no data touched."""
+    oh = pool_out_size(h, ky, sy)
+    ow = pool_out_size(w, kx, sx)
+    oy = np.arange(oh)[:, None, None] * sy
+    ox = np.arange(ow)[None, :, None] * sx
+    iy = np.arange(ky * kx)[None, None, :] // kx
+    ix = np.arange(ky * kx)[None, None, :] % kx
+    valid = ((oy + iy < h) & (ox + ix < w))          # (oh, ow, ky*kx)
+    return valid, valid.sum(axis=2, keepdims=True)
+
+
+def patches(xp, x, ky, kx, sy, sx, pad_value=0.0):
+    """``(patch, valid, count)`` where patch is (n, oh, ow, ky*kx, c) with
+    out-of-bounds elements set to ``pad_value``."""
+    n, h, w, c = x.shape
+    oh = pool_out_size(h, ky, sy)
+    ow = pool_out_size(w, kx, sx)
+    pb = (oh - 1) * sy + ky - h
+    pr = (ow - 1) * sx + kx - w
+    xpad = xp.pad(x, ((0, 0), (0, pb), (0, pr), (0, 0)),
+                  constant_values=pad_value)
+    parts = []
+    for iy in range(ky):
+        for ix in range(kx):
+            parts.append(xpad[:, iy:iy + oh * sy:sy, ix:ix + ow * sx:sx, :])
+    patch = xp.stack(parts, axis=3)
+    valid, count = window_counts(h, w, ky, kx, sy, sx)
+    return patch, xp.asarray(valid), count
+
+
+def offsets_of(xp, winner_idx, in_shape, ky, kx, sy, sx):
+    """Flat (row*W + col) input offset of window element ``winner_idx``
+    (n, oh, ow, c) — the reference's ``input_offset`` payload."""
+    _, h, w, _ = in_shape
+    oh, ow = winner_idx.shape[1], winner_idx.shape[2]
+    oy = xp.asarray(np.arange(oh)[None, :, None, None] * sy)
+    ox = xp.asarray(np.arange(ow)[None, None, :, None] * sx)
+    row = oy + winner_idx // kx
+    col = ox + winner_idx % kx
+    return (row * w + col).astype(xp.int32)
+
+
+def max_forward(xp, x, ky, kx, sy, sx, use_abs: bool = False):
+    """Returns ``(y, offsets)``."""
+    patch, valid, _ = patches(xp, x, ky, kx, sy, sx, pad_value=NEG_INF)
+    key = xp.abs(patch) if use_abs else patch
+    key = xp.where(valid[None, :, :, :, None], key, NEG_INF)
+    idx = key.argmax(axis=3)                                  # (n,oh,ow,c)
+    y = xp.take_along_axis(patch, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    return y, offsets_of(xp, idx, x.shape, ky, kx, sy, sx)
+
+
+def avg_forward(xp, x, ky, kx, sy, sx):
+    patch, _, count = patches(xp, x, ky, kx, sy, sx, pad_value=0.0)
+    return patch.sum(axis=3) / xp.asarray(count[None].astype(np.float32))
+
+
+def stochastic_forward(xp, x, ky, kx, sy, sx, uniform, use_abs: bool,
+                       train: bool):
+    """Zeiler&Fergus stochastic pooling.  ``uniform`` is (n, oh, ow, c) in
+    [0, 1) from the framework PRNG (host xorshift for numpy, counter-based
+    jax PRNG on device).  Returns ``(y, offsets)`` when training, else
+    ``(expectation, None)``."""
+    patch, valid, _ = patches(xp, x, ky, kx, sy, sx, pad_value=0.0)
+    vmask = valid[None, :, :, :, None]
+    p = xp.abs(patch) if use_abs else xp.maximum(patch, 0.0)
+    p = xp.where(vmask, p, 0.0)
+    total = p.sum(axis=3, keepdims=True)
+    if not train:
+        w = xp.where(total > 0, p / xp.where(total > 0, total, 1.0), 0.0)
+        return (patch * w).sum(axis=3), None
+    # inverse-CDF sampling with STRICT compare: a zero-total window (all
+    # probabilities 0, u = 0) then selects element 0, which is always
+    # in-bounds — the window origin is a real input cell
+    cdf = xp.cumsum(p, axis=3)
+    u = uniform[:, :, :, None, :] * total
+    idx = (cdf < u).sum(axis=3)
+    idx = xp.minimum(idx, ky * kx - 1)
+    y = xp.take_along_axis(patch, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    return y, offsets_of(xp, idx, x.shape, ky, kx, sy, sx)
+
+
+def scatter_backward(xp, err_output, offsets, in_shape):
+    """Route err to recorded winner offsets (max/stochastic backward)."""
+    n, h, w, c = in_shape
+    flat = offsets.reshape(n, -1, c)
+    e = err_output.reshape(n, -1, c)
+    if xp is np:
+        out = np.zeros((n, h * w, c), err_output.dtype)
+        ni = np.arange(n)[:, None, None]
+        ci = np.arange(c)[None, None, :]
+        np.add.at(out, (ni, flat, ci), e)
+    else:
+        out = jnp.zeros((n, h * w, c), err_output.dtype)
+        ni = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, None, :]
+        out = out.at[ni, flat, ci].add(e)
+    return out.reshape(in_shape)
+
+
+def avg_backward(xp, err_output, in_shape, ky, kx, sy, sx):
+    """Spread err uniformly over each (clipped) window."""
+    n, h, w, c = in_shape
+    oh = pool_out_size(h, ky, sy)
+    ow = pool_out_size(w, kx, sx)
+    _, count = window_counts(h, w, ky, kx, sy, sx)
+    e = err_output / xp.asarray(count[None].astype(np.float32))
+    pb = (oh - 1) * sy + ky - h
+    pr = (ow - 1) * sx + kx - w
+    if xp is np:
+        padded = np.zeros((n, h + pb, w + pr, c), err_output.dtype)
+        for iy in range(ky):
+            for ix in range(kx):
+                padded[:, iy:iy + oh * sy:sy, ix:ix + ow * sx:sx, :] += e
+    else:
+        padded = jnp.zeros((n, h + pb, w + pr, c), err_output.dtype)
+        for iy in range(ky):
+            for ix in range(kx):
+                padded = padded.at[
+                    :, iy:iy + oh * sy:sy, ix:ix + ow * sx:sx, :].add(e)
+    return padded[:, :h, :w, :]

@@ -43,6 +43,7 @@ try:                               # jax >= 0.8
 except ImportError:                # older jax
     from jax.experimental.shard_map import shard_map
 
+from znicz_tpu.core import prng
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TRAIN
 from znicz_tpu.ops import sgd
@@ -85,9 +86,10 @@ class FusedTrainStep(Unit):
         for fwd, gd in zip(self.forwards, self.gds):
             leaf = {k: put(arr.map_read())
                     for k, arr in fwd.param_arrays().items()}
-            leaf["vw"] = put(np.zeros_like(fwd.weights.map_read())) \
-                if not gd.gradient_weights \
-                else put(gd.gradient_weights.map_read())
+            if "w" in leaf:
+                leaf["vw"] = put(np.zeros_like(fwd.weights.map_read())) \
+                    if not gd.gradient_weights \
+                    else put(gd.gradient_weights.map_read())
             if "b" in leaf:
                 leaf["vb"] = put(np.zeros_like(fwd.bias.map_read())) \
                     if not gd.gradient_bias \
@@ -110,24 +112,32 @@ class FusedTrainStep(Unit):
         """Write the device params back into the unit Arrays (snapshot /
         inspection path; the hot loop never does this)."""
         for fwd, gd, leaf in zip(self.forwards, self.gds, self._params):
-            fwd.weights.set_devmem(leaf["w"])
-            gd.gradient_weights.set_devmem(leaf["vw"])
+            if "w" in leaf:
+                fwd.weights.set_devmem(leaf["w"])
+                gd.gradient_weights.set_devmem(leaf["vw"])
             if "b" in leaf:
                 fwd.bias.set_devmem(leaf["b"])
                 gd.gradient_bias.set_devmem(leaf["vb"])
 
     # -- forward / loss composition -----------------------------------------
-    def _forward_chain(self, params, x, train: bool):
+    def _forward_chain(self, params, x, train: bool, rng=None):
         """Compose the forwards; returns pre-softmax logits when the last
-        layer is All2AllSoftmax (loss uses log_softmax directly)."""
+        layer is All2AllSoftmax (loss uses log_softmax directly).
+
+        ``rng`` is a per-step key; each NEEDS_RNG unit (dropout, stochastic
+        pooling) gets a per-unit fold so masks are independent across units
+        and steps."""
         last = len(self.forwards) - 1
         logits_tail = isinstance(self.forwards[last], All2AllSoftmax) and \
             isinstance(self.evaluator, EvaluatorSoftmax)
         for i, (fwd, p) in enumerate(zip(self.forwards, params)):
+            unit_rng = None
+            if getattr(fwd, "NEEDS_RNG", False) and rng is not None:
+                unit_rng = jax.random.fold_in(rng, i)
             if i == last and logits_tail:
                 x = fwd.xla_apply_linear(p, x)
             else:
-                x = fwd.xla_apply(p, x)
+                x = fwd.xla_apply(p, x, rng=unit_rng, train=train)
         return x, logits_tail
 
     def _loss_and_metrics(self, out, logits_tail, labels, mask):
@@ -154,7 +164,9 @@ class FusedTrainStep(Unit):
         raise TypeError(f"unsupported evaluator {type(self.evaluator)}")
 
     # -- compiled step bodies ------------------------------------------------
-    def _local_train(self, params, hyper, x, labels, mask):
+    def _local_train(self, params, hyper, rng, x, labels, mask):
+        # decorrelate dropout/stochastic masks across data shards
+        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
         # differentiate only the trainable leaves — the momentum buffers
         # vw/vb never enter the loss and would otherwise get same-shaped
         # zero cotangents materialized every step
@@ -162,7 +174,8 @@ class FusedTrainStep(Unit):
                      for leaf in params]
 
         def loss_fn(ps):
-            out, logits_tail = self._forward_chain(ps, x, train=True)
+            out, logits_tail = self._forward_chain(ps, x, train=True,
+                                                   rng=rng)
             loss, metrics = self._loss_and_metrics(
                 out, logits_tail, labels, mask)
             # the gradient plane: differentiating through this psum makes AD
@@ -179,9 +192,10 @@ class FusedTrainStep(Unit):
         new_params = []
         for leaf, grad, h in zip(params, grads, hyper):
             new = dict(leaf)
-            new["w"], new["vw"] = sgd.update(
-                jnp, leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
-                h["l1"], h["mom"], bs)
+            if "w" in leaf:
+                new["w"], new["vw"] = sgd.update(
+                    jnp, leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
+                    h["l1"], h["mom"], bs)
             if "b" in leaf:
                 new["b"], new["vb"] = sgd.update(
                     jnp, leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
@@ -216,7 +230,7 @@ class FusedTrainStep(Unit):
         self._params = self.gather_params()
         rep, sh = P(), P("data")
         train = shard_map(self._local_train, mesh=self.mesh,
-                          in_specs=(rep, rep, sh, sh, sh),
+                          in_specs=(rep, rep, rep, sh, sh, sh),
                           out_specs=(rep, rep))
         evalf = shard_map(self._local_eval, mesh=self.mesh,
                           in_specs=(rep, sh, sh, sh),
@@ -237,7 +251,8 @@ class FusedTrainStep(Unit):
         mask = loader.minibatch_indices.mem >= 0
         if int(loader.minibatch_class) == TRAIN:
             self._params, metrics = self._train_fn(
-                self._params, self.hyper_params(), x, labels, mask)
+                self._params, self.hyper_params(), prng.get().key(),
+                x, labels, mask)
         else:
             metrics = self._eval_fn(self._params, x, labels, mask)
         # host-side scalars for the Decision (one device sync per minibatch;

@@ -1,0 +1,219 @@
+"""Standalone activation units — rebuild of veles.znicz activation.py ::
+ActivationForward / ActivationBackward pairs {Tanh, RELU, StrictRELU,
+Sigmoid, Log, SinCos, TanhLog, Mul}.
+
+For nets where the activation is decoupled from FC/conv (SURVEY.md §3.1).
+Each pair shares a name in the MAPPING registry so StandardWorkflow can
+instantiate the backward chain automatically.  ``Mul`` is the elementwise
+product of two linked inputs (gating) — formula reconstructed, reference
+detail was [MED].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.ops import activations
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase
+
+
+class ActivationForward(Forward):
+    """Elementwise activation as its own unit."""
+
+    MAPPING: set = set()
+    ACTIVATION = activations.LINEAR
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, include_bias=False, **kwargs)
+
+    def _common_init(self, **kwargs) -> None:
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(shape=self.input.shape)
+        self.init_array(self.input, self.output)
+
+    def xla_apply(self, p: dict, x, *, rng=None, train=True):
+        return activations.forward(jnp, self.ACTIVATION, x)
+
+    def numpy_run(self) -> None:
+        self.output.map_invalidate()
+        self.output.mem = activations.forward(np, self.ACTIVATION,
+                                              self.input.mem)
+
+    def xla_init(self) -> None:
+        act = self.ACTIVATION
+        self._xla_fn = jax.jit(lambda x: activations.forward(jnp, act, x))
+
+    def xla_run(self) -> None:
+        self.input.unmap()
+        self.output.set_devmem(self._xla_fn(self.input.devmem))
+
+
+class ActivationBackward(GradientDescentBase):
+    """err_input = err_output * act'(input) — has both input and output
+    linked (reference: ActivationBackward)."""
+
+    MAPPING: set = set()
+    ACTIVATION = activations.LINEAR
+
+    def link_from_forward(self, forward) -> "ActivationBackward":
+        self.link_attrs(forward, "input", "output")
+        return self
+
+    def _common_init(self, **kwargs) -> None:
+        super()._common_init(**kwargs)
+        if not self.err_input or self.err_input.shape != self.err_output.shape:
+            self.err_input.reset(shape=self.err_output.shape)
+        self.init_array(self.err_input, self.err_output)
+
+    def _backward(self, xp, x, y, e):
+        return e * activations.derivative_from_input(
+            xp, self.ACTIVATION, x, y)
+
+    def numpy_run(self) -> None:
+        err_in = self._backward(np, self.input.map_read(),
+                                self.output.map_read(),
+                                self.err_output.map_read())
+        self.err_input.map_invalidate()
+        self.err_input.mem = err_in
+
+    def xla_init(self) -> None:
+        self._xla_fn = jax.jit(
+            lambda x, y, e: self._backward(jnp, x, y, e))
+
+    def xla_run(self) -> None:
+        for arr in (self.input, self.output, self.err_output):
+            arr.unmap()
+        self.err_input.set_devmem(self._xla_fn(
+            self.input.devmem, self.output.devmem, self.err_output.devmem))
+
+
+class ForwardTanh(ActivationForward):
+    MAPPING = {"activation_tanh"}
+    ACTIVATION = activations.TANH
+
+
+class BackwardTanh(ActivationBackward):
+    MAPPING = {"activation_tanh"}
+    ACTIVATION = activations.TANH
+
+
+class ForwardRELU(ActivationForward):
+    MAPPING = {"activation_relu"}
+    ACTIVATION = activations.RELU
+
+
+class BackwardRELU(ActivationBackward):
+    MAPPING = {"activation_relu"}
+    ACTIVATION = activations.RELU
+
+
+class ForwardStrictRELU(ActivationForward):
+    MAPPING = {"activation_str"}
+    ACTIVATION = activations.STRICT_RELU
+
+
+class BackwardStrictRELU(ActivationBackward):
+    MAPPING = {"activation_str"}
+    ACTIVATION = activations.STRICT_RELU
+
+
+class ForwardSigmoid(ActivationForward):
+    MAPPING = {"activation_sigmoid"}
+    ACTIVATION = activations.SIGMOID
+
+
+class BackwardSigmoid(ActivationBackward):
+    MAPPING = {"activation_sigmoid"}
+    ACTIVATION = activations.SIGMOID
+
+
+class ForwardLog(ActivationForward):
+    MAPPING = {"activation_log"}
+    ACTIVATION = activations.LOG
+
+
+class BackwardLog(ActivationBackward):
+    MAPPING = {"activation_log"}
+    ACTIVATION = activations.LOG
+
+
+class ForwardSinCos(ActivationForward):
+    MAPPING = {"activation_sincos"}
+    ACTIVATION = activations.SINCOS
+
+
+class BackwardSinCos(ActivationBackward):
+    MAPPING = {"activation_sincos"}
+    ACTIVATION = activations.SINCOS
+
+
+class ForwardTanhLog(ActivationForward):
+    MAPPING = {"activation_tanhlog"}
+    ACTIVATION = activations.TANHLOG
+
+
+class BackwardTanhLog(ActivationBackward):
+    MAPPING = {"activation_tanhlog"}
+    ACTIVATION = activations.TANHLOG
+
+
+class ForwardMul(ActivationForward):
+    """y = input * input2 (elementwise gate)."""
+
+    MAPPING = {"activation_mul"}
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.input2 = Array()
+
+    def xla_apply(self, p: dict, x, *, rng=None, train=True):
+        # the single-input fused-chain protocol cannot thread input2;
+        # refuse rather than silently degrade to identity
+        raise NotImplementedError(
+            "ForwardMul (two-input gate) is eager-only; keep it outside "
+            "the fused segment")
+
+    def numpy_run(self) -> None:
+        self.output.map_invalidate()
+        self.output.mem = self.input.map_read() * self.input2.map_read()
+
+    def xla_init(self) -> None:
+        self._xla_fn = jax.jit(lambda a, b: a * b)
+
+    def xla_run(self) -> None:
+        for arr in (self.input, self.input2):
+            arr.unmap()
+        self.output.set_devmem(self._xla_fn(self.input.devmem,
+                                            self.input2.devmem))
+
+
+class BackwardMul(ActivationBackward):
+    """err_input = err_output * input2."""
+
+    MAPPING = {"activation_mul"}
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.input2 = Array()
+
+    def link_from_forward(self, forward) -> "BackwardMul":
+        self.link_attrs(forward, "input", "output", "input2")
+        return self
+
+    def numpy_run(self) -> None:
+        self.err_input.map_invalidate()
+        self.err_input.mem = self.err_output.map_read() * \
+            self.input2.map_read()
+
+    def xla_init(self) -> None:
+        self._xla_fn = jax.jit(lambda e, b: e * b)
+
+    def xla_run(self) -> None:
+        for arr in (self.err_output, self.input2):
+            arr.unmap()
+        self.err_input.set_devmem(self._xla_fn(self.err_output.devmem,
+                                               self.input2.devmem))

@@ -66,7 +66,7 @@ class All2All(Forward):
             out["b"] = self.bias
         return out
 
-    def xla_apply(self, p: dict, x):
+    def xla_apply(self, p: dict, x, *, rng=None, train=True):
         """Pure jnp forward over a params leaf-dict (traced once into the
         fused training step)."""
         return activations.forward(jnp, self.ACTIVATION,
@@ -135,7 +135,7 @@ class All2AllSoftmax(All2All):
         super().__init__(workflow, **kwargs)
         self.max_idx = Array()
 
-    def xla_apply(self, p: dict, x):
+    def xla_apply(self, p: dict, x, *, rng=None, train=True):
         return jax.nn.softmax(self.xla_apply_linear(p, x), axis=1)
 
     def _common_init(self, **kwargs) -> None:

@@ -1,0 +1,123 @@
+"""Dropout units — rebuild of veles.znicz dropout.py :: DropoutForward,
+DropoutBackward.
+
+Forward draws a Bernoulli mask (keep prob ``1 - dropout_ratio``) from the
+framework PRNG and scales kept activations by ``1/(1-p)`` (reference
+semantics: the mask Array holds 0 or 1/(1-p) and the backward reuses it).
+Disabled in ``forward_mode`` (inference) — identity.  The reference's
+device xorshift128+ mask generator maps to counter-based ``jax.random``
+keys (znicz_tpu.core.prng :: RandomGenerator.key).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.memory import Array
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase
+
+
+class DropoutForward(Forward):
+    """Reference: DropoutForward (attribute ``dropout_ratio`` = drop prob)."""
+
+    MAPPING = {"dropout"}
+    NEEDS_RNG = True
+
+    def __init__(self, workflow=None, dropout_ratio=0.5, **kwargs) -> None:
+        super().__init__(workflow, include_bias=False, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+        self.mask = Array()
+
+    def _common_init(self, **kwargs) -> None:
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(shape=self.input.shape)
+        if not self.mask or self.mask.shape != self.input.shape:
+            self.mask.reset(shape=self.input.shape)
+        self.init_array(self.input, self.output, self.mask)
+
+    def _make_mask_np(self, shape):
+        keep = 1.0 - self.dropout_ratio
+        u = prng.get().uniform(0.0, 1.0, shape)
+        return (u >= self.dropout_ratio).astype(np.float32) / keep
+
+    def xla_apply(self, p: dict, x, *, rng=None, train=True):
+        if not train or self.dropout_ratio == 0.0:
+            return x
+        keep = 1.0 - self.dropout_ratio
+        mask = (jax.random.uniform(rng, x.shape) >=
+                self.dropout_ratio).astype(x.dtype) / keep
+        return x * mask
+
+    def numpy_run(self) -> None:
+        x = self.input.mem
+        self.output.map_invalidate()
+        if self.forward_mode or self.dropout_ratio == 0.0:
+            self.output.mem = x
+            return
+        mask = self._make_mask_np(x.shape)
+        self.mask.map_invalidate()
+        self.mask.mem = mask
+        self.output.mem = x * mask
+
+    def xla_init(self) -> None:
+        ratio = self.dropout_ratio
+        keep = 1.0 - ratio
+
+        def fn(x, key):
+            mask = (jax.random.uniform(key, x.shape) >= ratio
+                    ).astype(x.dtype) / keep
+            return x * mask, mask
+
+        self._xla_fn = jax.jit(fn)
+
+    def xla_run(self) -> None:
+        self.input.unmap()
+        if self.forward_mode or self.dropout_ratio == 0.0:
+            self.output.set_devmem(self.input.devmem)
+            return
+        y, mask = self._xla_fn(self.input.devmem, prng.get().key())
+        self.output.set_devmem(y)
+        self.mask.set_devmem(mask)
+
+
+class DropoutBackward(GradientDescentBase):
+    """Reference: DropoutBackward — err * mask (mask already holds the
+    1/(1-p) scale)."""
+
+    MAPPING = {"dropout"}
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.mask = Array()  # linked from the forward
+
+    def link_from_forward(self, forward) -> "DropoutBackward":
+        self.link_attrs(forward, "input", "output", "mask")
+        self.forward_unit = forward
+        return self
+
+    def _common_init(self, **kwargs) -> None:
+        super()._common_init(**kwargs)
+        if not self.err_input or self.err_input.shape != self.err_output.shape:
+            self.err_input.reset(shape=self.err_output.shape)
+        self.init_array(self.err_input, self.err_output)
+
+    def numpy_run(self) -> None:
+        e = self.err_output.map_read()
+        self.err_input.map_invalidate()
+        if not self.mask:
+            self.err_input.mem = e
+            return
+        self.err_input.mem = e * self.mask.map_read()
+
+    def xla_run(self) -> None:
+        if not self.mask:
+            self.err_output.unmap()
+            self.err_input.set_devmem(self.err_output.devmem)
+            return
+        for arr in (self.err_output, self.mask):
+            arr.unmap()
+        self.err_input.set_devmem(self.err_output.devmem * self.mask.devmem)

@@ -114,6 +114,23 @@ class Forward(NNLayerBase):
             return np.full(shape, stddev, dtype=np.float32)
         raise ValueError(f"unknown filling {filling!r}")
 
+    # -- fused-step protocol (znicz_tpu.parallel.step) ----------------------
+    def param_arrays(self) -> dict:
+        """Trainable Arrays contributed to the fused step's params pytree;
+        paramless units (pooling, dropout, ...) return {}."""
+        return {}
+
+    def xla_apply(self, p: dict, x, *, rng=None, train=True):
+        """Pure jnp forward over a params leaf-dict, traced once into the
+        fused training step.  ``rng`` is a per-unit per-step jax PRNG key
+        (supplied when the class sets ``NEEDS_RNG``); ``train`` is a
+        trace-time flag (dropout/stochastic pooling switch off for eval)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support the fused step")
+
+    #: class flag: xla_apply consumes a PRNG key each step
+    NEEDS_RNG = False
+
     def init_weights(self, n_input: int, n_output: int) -> None:
         if not self.weights:
             stddev = self.weights_stddev or min(0.05, 1.0 / np.sqrt(n_input))
@@ -176,6 +193,11 @@ class GradientDescentBase(NNLayerBase):
         self.weights_transposed = False
         self.err_input = Array()
         self.err_output = Array()
+        # empty defaults; paramful gd units overwrite them with data links
+        # (link_attrs pops the instance attribute) — paramless ones
+        # (pooling, LRN, dropout, activations) just see empty Arrays
+        self.weights = Array()
+        self.bias = Array()
         self.gradient_weights = Array()
         self.gradient_bias = Array()
 
