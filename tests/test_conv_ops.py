@@ -214,6 +214,29 @@ def test_lrn_forward_backward_parity_and_numeric():
                                    rtol=1e-4, atol=1e-7)
 
 
+def test_lrn_backward_even_window_numeric():
+    """Even n makes the channel window asymmetric; the backward must use
+    the adjoint (mirrored) padding — regression for the even-n gradient."""
+    rng = np.random.default_rng(14)
+    x = rng.normal(size=(2, 3, 3, 6)).astype(np.float64)
+    err = rng.normal(size=x.shape)
+    for n in (2, 4):
+        args = (1e-2, 0.75, 2.0, n)
+        ein = lrn_ops.backward(np, x, err, *args)
+        eps = 1e-6
+        flat = x.ravel()
+        for i in rng.choice(flat.size, 8, replace=False):
+            old = flat[i]
+            flat[i] = old + eps
+            up = (lrn_ops.forward(np, x, *args) * err).sum()
+            flat[i] = old - eps
+            down = (lrn_ops.forward(np, x, *args) * err).sum()
+            flat[i] = old
+            np.testing.assert_allclose(
+                ein.ravel()[i], (up - down) / (2 * eps),
+                rtol=1e-4, atol=1e-7, err_msg=f"n={n}")
+
+
 def test_lrn_autograd_matches_hand_backward():
     """The fused step differentiates the jnp forward with AD; pin that AD
     and the hand-written exact backward agree."""

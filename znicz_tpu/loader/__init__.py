@@ -5,8 +5,11 @@ classes each epoch with deterministic shuffling; ``FullBatchLoader`` holds
 the whole dataset in one Array (optionally device-resident).
 """
 
-from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, CLASS_NAMES
+from znicz_tpu.loader.base import (Loader, TEST, VALID, TRAIN, CLASS_NAMES,
+                                   register_loader, get_loader)
 from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+from znicz_tpu.loader import synthetic  # noqa: F401  (registry population)
 
 __all__ = ["Loader", "FullBatchLoader", "FullBatchLoaderMSE",
-           "TEST", "VALID", "TRAIN", "CLASS_NAMES"]
+           "TEST", "VALID", "TRAIN", "CLASS_NAMES",
+           "register_loader", "get_loader"]

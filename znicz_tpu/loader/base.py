@@ -27,6 +27,28 @@ from znicz_tpu.core.accelerated_units import AcceleratedUnit
 TEST, VALID, TRAIN = 0, 1, 2
 CLASS_NAMES = ("test", "validation", "train")
 
+#: loader registry behind StandardWorkflow's ``loader_name`` lookup
+#: (reference: veles/loader/base.py registry consumed by
+#: standard_workflow.py :: StandardWorkflowBase)
+LOADER_REGISTRY: dict[str, type] = {}
+
+
+def register_loader(name: str):
+    """Class decorator: register under ``name`` for loader_name lookup."""
+    def deco(cls):
+        LOADER_REGISTRY[name] = cls
+        cls.LOADER_NAME = name
+        return cls
+    return deco
+
+
+def get_loader(name: str) -> type:
+    try:
+        return LOADER_REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown loader {name!r}; registered: "
+                       f"{sorted(LOADER_REGISTRY)}") from None
+
 
 class Loader(AcceleratedUnit):
     """Minibatch server over an abstract dataset."""

@@ -12,7 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from znicz_tpu.core import prng
-from znicz_tpu.loader.base import TEST, VALID, TRAIN
+from znicz_tpu.loader.base import TEST, VALID, TRAIN, register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 
 
@@ -42,6 +42,7 @@ def make_blobs(n_per_class: dict[int, int], n_classes: int,
     return data, np.concatenate(label_parts), lengths
 
 
+@register_loader("synthetic_classifier")
 class SyntheticClassifierLoader(FullBatchLoader):
     """Seeded Gaussian-blob classification dataset (MNIST stand-in)."""
 
@@ -67,6 +68,7 @@ class SyntheticClassifierLoader(FullBatchLoader):
         self.class_lengths = lengths
 
 
+@register_loader("synthetic_image")
 class SyntheticImageLoader(SyntheticClassifierLoader):
     """Blob classes rendered as (H, W, C) images — conv-stack test data."""
 
@@ -74,6 +76,7 @@ class SyntheticImageLoader(SyntheticClassifierLoader):
         super().__init__(workflow, sample_shape=sample_shape, **kwargs)
 
 
+@register_loader("synthetic_regression")
 class SyntheticRegressionLoader(FullBatchLoaderMSE):
     """Seeded regression dataset: targets are a fixed random linear map of
     the inputs plus noise (autoencoder/MSE workflow test data)."""

@@ -1,0 +1,14 @@
+"""Dropout mask op — rebuild of the reference's dropout.{cl,cu} mask-gen
+kernel (SURVEY.md §3.2).  One definition shared by every execution path
+(numpy oracle, eager xla, fused step) so the mask semantics cannot diverge.
+"""
+
+from __future__ import annotations
+
+
+def make_mask(xp, u, ratio: float, dtype):
+    """Bernoulli keep-mask from uniforms ``u`` in [0,1): kept entries hold
+    ``1/(1-ratio)`` (inverted-dropout scale, reference semantics), dropped
+    entries 0."""
+    keep = 1.0 - ratio
+    return (u >= ratio).astype(dtype) / keep

@@ -11,18 +11,24 @@ Backward is the exact derivative, not an approximation:
     dL/dx_j = e_j d_j^(-beta)
               - 2 alpha beta x_j * sum_{i: j in window(i)} e_i x_i d_i^(-beta-1)
 
-and because the window is symmetric the inverse-neighbourhood sum is the
-same sliding window applied to ``t = e * x * d^(-beta-1)``.
+The inverse-neighbourhood sum ``sum_{i: j in window(i)}`` is the adjoint of
+the forward window: for odd ``n`` (symmetric window) it equals the forward
+sliding sum applied to ``t = e * x * d^(-beta-1)``; for even ``n`` the
+centring is asymmetric (window of output i covers [i-n//2, i+n-1-n//2]),
+so the adjoint uses the mirrored padding.
 """
 
 from __future__ import annotations
 
 
-def window_sum(xp, x, n: int):
+def window_sum(xp, x, n: int, adjoint: bool = False):
     """Sliding sum over the channel (last) axis, window ``n`` centred,
-    zero-padded — static python loop, fuses under XLA."""
+    zero-padded — static python loop, fuses under XLA.  ``adjoint=True``
+    mirrors the padding, giving the transpose of the forward operator
+    (identical for odd n)."""
     half = n // 2
-    pad = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+    lo, hi = (n - 1 - half, half) if adjoint else (half, n - 1 - half)
+    pad = [(0, 0)] * (x.ndim - 1) + [(lo, hi)]
     xpad = xp.pad(x, pad)
     c = x.shape[-1]
     acc = xpad[..., 0:c]
@@ -40,4 +46,4 @@ def backward(xp, x, err_output, alpha: float, beta: float, k: float, n: int):
     d = k + alpha * window_sum(xp, x * x, n)
     t = err_output * x * d ** (-beta - 1.0)
     return err_output * d ** (-beta) - 2.0 * alpha * beta * x * window_sum(
-        xp, t, n)
+        xp, t, n, adjoint=True)

@@ -1,0 +1,252 @@
+"""Declarative workflow builder — rebuild of veles.znicz
+standard_workflow.py :: StandardWorkflowBase, StandardWorkflow.
+
+``StandardWorkflow(layers=[{"type": "conv_relu", "->": {...geometry...},
+"<-": {...gd hyperparams...}}, ...])`` turns a list-of-dicts description
+into the full training graph: Repeater -> Loader -> forwards -> Evaluator
+-> Decision -> gradient chain -> Repeater, plus the gated side chain
+(snapshotter/plotters, linked by the service hooks below).  This is the API
+every reference sample uses (SURVEY.md §2 L7).
+
+Two execution shapes (SURVEY.md §8 design stance):
+
+- ``fused=True`` (TPU-native default): the accelerated segment collapses
+  into one ``FusedTrainStep`` jitted over a device mesh; forwards/gds exist
+  as units (weights, hyperparams, momentum buffers) but the hot loop is a
+  single XLA program.
+- ``fused=False``: reference-style per-unit control graph, each unit
+  running its own numpy/xla kernel per minibatch — the tier-1 oracle shape.
+
+Layer spec keys: ``type`` (MatchingObject registry name), ``->`` (forward
+constructor kwargs), ``<-`` (gradient/hyperparameter kwargs), ``name``;
+any other key is shorthand for a forward kwarg (the reference accepts the
+same flat style).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core.plumbing import Repeater
+from znicz_tpu.loader.base import TRAIN, get_loader
+from znicz_tpu.parallel.step import FusedTrainStep
+import znicz_tpu.units  # noqa: F401  (populates the MatchingObject registry)
+from znicz_tpu.units.all2all import All2AllSoftmax
+from znicz_tpu.units.decision import DecisionGD, DecisionMSE
+from znicz_tpu.units.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from znicz_tpu.units.nn_units import (Forward, MatchingObject, NNWorkflow)
+
+
+class StandardWorkflowBase(NNWorkflow):
+    """Layer-list parsing + forward-chain construction (reference:
+    standard_workflow.py :: StandardWorkflowBase)."""
+
+    def __init__(self, workflow=None, layers=None, loader_name=None,
+                 loader_config=None, loader_factory=None, loader_unit=None,
+                 name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        if not layers:
+            raise ValueError("StandardWorkflow requires a non-empty layers=[]")
+        self.layer_specs = [self._parse_layer(sp) for sp in layers]
+        self._loader_name = loader_name
+        self._loader_config = dict(loader_config or {})
+        self._loader_factory = loader_factory
+        self._loader_unit = loader_unit
+
+    @staticmethod
+    def _parse_layer(spec) -> tuple:
+        """-> (type_name, unit_name, fwd_kwargs, gd_kwargs)."""
+        if isinstance(spec, str):
+            spec = {"type": spec}
+        spec = dict(spec)
+        type_name = spec.pop("type")
+        fwd_kwargs = dict(spec.pop("->", {}))
+        gd_kwargs = dict(spec.pop("<-", {}))
+        unit_name = spec.pop("name", None)
+        fwd_kwargs.update(spec)  # flat shorthand
+        return type_name, unit_name, fwd_kwargs, gd_kwargs
+
+    # -- builder hooks (reference method names kept) ------------------------
+    def link_repeater(self) -> Repeater:
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+        return self.repeater
+
+    def link_loader(self, *parents) -> None:
+        if self._loader_unit is not None:
+            self.loader = self._loader_unit
+        elif self._loader_factory is not None:
+            self.loader = self._loader_factory(self)
+        elif self._loader_name is not None:
+            self.loader = get_loader(self._loader_name)(
+                self, **self._loader_config)
+        else:
+            raise ValueError("no loader: pass loader_name/loader_factory/"
+                             "loader_unit")
+        self.loader.link_from(*parents)
+
+    def link_forwards(self, loader_attr: str = "minibatch_data",
+                      *parents) -> None:
+        """Instantiate the forward chain from the parsed specs and wire both
+        control (sequential) and data (output->input) links."""
+        self.forwards = []
+        prev_unit = None
+        for i, (type_name, unit_name, fwd_kwargs, _) in \
+                enumerate(self.layer_specs):
+            cls = MatchingObject.forwards.get(type_name)
+            if cls is None:
+                raise KeyError(f"unknown layer type {type_name!r}; known: "
+                               f"{sorted(MatchingObject.forwards)}")
+            fwd = cls(self, name=unit_name or f"{type_name}{i}", **fwd_kwargs)
+            if prev_unit is None:
+                fwd.link_from(*parents)
+                fwd.link_attrs(self.loader, ("input", loader_attr))
+            else:
+                fwd.link_from(prev_unit)
+                fwd.link_attrs(prev_unit, ("input", "output"))
+            self.forwards.append(fwd)
+            prev_unit = fwd
+
+
+class StandardWorkflow(StandardWorkflowBase):
+    """Full declarative training workflow (reference: StandardWorkflow).
+
+    Parameters mirror the reference: ``loss_function`` ("softmax" | "mse"),
+    ``decision_config`` (max_epochs, fail_iterations), ``loader_name`` +
+    ``loader_config`` (registry lookup).  TPU extensions: ``fused`` and
+    ``mesh`` select the one-XLA-program execution shape and its device mesh.
+    """
+
+    def __init__(self, workflow=None, layers=None,
+                 loss_function: str = "softmax",
+                 decision_config: Optional[dict] = None,
+                 snapshotter_config: Optional[dict] = None,
+                 fused: bool = True, mesh=None, **kwargs) -> None:
+        super().__init__(workflow, layers=layers, **kwargs)
+        if loss_function not in ("softmax", "mse"):
+            raise ValueError(f"unknown loss_function {loss_function!r}")
+        self.loss_function = loss_function
+        self.decision_config = dict(decision_config or {})
+        self.snapshotter_config = snapshotter_config
+        self.fused = fused
+        self.mesh = mesh
+        self.snapshotter = None
+        self.create_workflow()
+
+    # -- graph assembly ------------------------------------------------------
+    def create_workflow(self) -> None:
+        self.link_repeater()
+        self.link_loader(self.repeater)
+        self.link_forwards("minibatch_data", self.loader)
+        self.link_evaluator(self.forwards[-1])
+        self.link_decision(self.evaluator)
+        if self.fused:
+            self.link_fused_step()
+        else:
+            self.link_gds()
+        self.link_snapshotter()
+        # the loop back-edge: exactly ONE provider — the Repeater fires on
+        # any signal, so a second edge would double-run each minibatch
+        self.repeater.link_from(self._tail)
+        self.link_end_point()
+
+    def link_evaluator(self, parent: Forward) -> None:
+        if self.loss_function == "softmax":
+            if not isinstance(self.forwards[-1], All2AllSoftmax):
+                raise ValueError('loss_function="softmax" requires the last '
+                                 'layer to be of type "softmax"')
+            ev = self.evaluator = EvaluatorSoftmax(self)
+            ev.link_attrs(parent, "output", "max_idx")
+            ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
+                          ("batch_size", "minibatch_size"))
+        else:
+            ev = self.evaluator = EvaluatorMSE(self)
+            ev.link_attrs(parent, "output")
+            ev.link_attrs(self.loader, ("target", "minibatch_targets"),
+                          ("batch_size", "minibatch_size"))
+        ev.link_from(parent)
+
+    def link_decision(self, parent) -> None:
+        cls = DecisionGD if self.loss_function == "softmax" else DecisionMSE
+        dec = self.decision = cls(self, **self.decision_config)
+        dec.link_from(parent)
+        dec.link_attrs(self.loader, "minibatch_class", "last_minibatch",
+                       "class_lengths", "epoch_number", "minibatch_size")
+        if self.loss_function == "softmax":
+            dec.link_attrs(self.evaluator, ("minibatch_n_err", "n_err"))
+            dec.evaluator = self.evaluator
+        else:
+            dec.link_attrs(self.evaluator, ("minibatch_mse", "mse"))
+
+    def _make_gds(self) -> None:
+        """Instantiate gradient units paired to the forwards (forward
+        order), wiring the shared-weight data links."""
+        self.gds = []
+        for (type_name, unit_name, _, gd_kwargs), fwd in \
+                zip(self.layer_specs, self.forwards):
+            gd_cls = MatchingObject.gds.get(type_name)
+            if gd_cls is None:
+                raise KeyError(f"no gradient unit for type {type_name!r}")
+            gd = gd_cls(self, name=f"gd_{fwd.name}", **gd_kwargs)
+            gd.link_from_forward(fwd)
+            gd.link_attrs(self.loader, ("batch_size", "minibatch_size"))
+            self.gds.append(gd)
+        # err chain: evaluator feeds the last gd; each gd feeds the previous
+        self.gds[-1].link_attrs(self.evaluator, "err_output")
+        for up, down in zip(self.gds, self.gds[1:]):
+            up.link_attrs(down, ("err_output", "err_input"))
+        self.gds[0].need_err_input = False
+
+    def link_gds(self) -> None:
+        """Eager backward chain: gds run in reverse order after Decision,
+        skipped on non-train minibatches (reference control shape)."""
+        self._make_gds()
+        prev = self.decision
+        for gd in reversed(self.gds):
+            gd.link_from(prev)
+            gd.gate_skip = Bool(
+                lambda: int(self.loader.minibatch_class) != TRAIN)
+            prev = gd
+        self._tail = prev
+
+    def link_fused_step(self) -> None:
+        """TPU-native shape: forwards/evaluator/gds subsumed by one
+        FusedTrainStep; control graph is Repeater -> Loader -> Step ->
+        Decision."""
+        self._make_gds()
+        step = self.step = FusedTrainStep(
+            self, forwards=self.forwards, evaluator=self.evaluator,
+            gds=self.gds, loader=self.loader, mesh=self.mesh,
+            name="FusedStep")
+        # re-route control: loader -> step -> decision
+        step.link_from(self.loader)
+        # evaluator/forwards keep their data links but leave the control
+        # graph; Decision re-links to read the step's metric mirrors
+        self.evaluator.unlink_all()
+        for fwd in self.forwards:
+            fwd.unlink_all()
+        self.decision.unlink_all()
+        self.decision.link_from(step)
+        if self.loss_function == "softmax":
+            self.decision.link_attrs(step, ("minibatch_n_err", "n_err"))
+        else:
+            self.decision.link_attrs(step, ("minibatch_mse", "mse"))
+        self._tail = self.decision
+
+    def link_snapshotter(self) -> None:
+        """Gated snapshotter side chain (lands with znicz_tpu.snapshotter;
+        no-op when snapshotter_config is None)."""
+        if self.snapshotter_config is None:
+            return
+        from znicz_tpu.snapshotter import NNSnapshotter
+        snap = self.snapshotter = NNSnapshotter(self,
+                                                **self.snapshotter_config)
+        snap.link_from(self._tail)
+        snap.link_workflow_state(self)
+        snap.gate_skip = ~self.decision.epoch_ended
+        self._tail = snap
+
+    def link_end_point(self) -> None:
+        self.end_point.link_from(self._tail)
+        self.end_point.gate_block = ~self.decision.complete

@@ -3,4 +3,11 @@
 Forward/gradient unit pairs over the pure ops in ``znicz_tpu.ops``; every
 unit has a ``numpy`` oracle path and an ``xla`` TPU path (the reference's
 numpy/ocl/cuda triple collapsed to numpy/xla).
+
+Importing this package imports every unit module so the MatchingObject
+fwd<->gd registry is fully populated (StandardWorkflow's layer-type lookup
+depends on it).
 """
+
+from znicz_tpu.units import (activation, all2all, conv, dropout,  # noqa: F401
+                             gd, gd_conv, gd_pooling, normalization, pooling)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.memory import Array
+from znicz_tpu.ops.dropout import make_mask
 from znicz_tpu.units.nn_units import Forward, GradientDescentBase
 
 
@@ -40,17 +41,14 @@ class DropoutForward(Forward):
         self.init_array(self.input, self.output, self.mask)
 
     def _make_mask_np(self, shape):
-        keep = 1.0 - self.dropout_ratio
         u = prng.get().uniform(0.0, 1.0, shape)
-        return (u >= self.dropout_ratio).astype(np.float32) / keep
+        return make_mask(np, u, self.dropout_ratio, np.float32)
 
     def xla_apply(self, p: dict, x, *, rng=None, train=True):
         if not train or self.dropout_ratio == 0.0:
             return x
-        keep = 1.0 - self.dropout_ratio
-        mask = (jax.random.uniform(rng, x.shape) >=
-                self.dropout_ratio).astype(x.dtype) / keep
-        return x * mask
+        return x * make_mask(jnp, jax.random.uniform(rng, x.shape),
+                             self.dropout_ratio, x.dtype)
 
     def numpy_run(self) -> None:
         x = self.input.mem
@@ -65,11 +63,10 @@ class DropoutForward(Forward):
 
     def xla_init(self) -> None:
         ratio = self.dropout_ratio
-        keep = 1.0 - ratio
 
         def fn(x, key):
-            mask = (jax.random.uniform(key, x.shape) >= ratio
-                    ).astype(x.dtype) / keep
+            mask = make_mask(jnp, jax.random.uniform(key, x.shape), ratio,
+                             x.dtype)
             return x * mask, mask
 
         self._xla_fn = jax.jit(fn)
