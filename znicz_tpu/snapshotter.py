@@ -1,0 +1,191 @@
+"""Checkpoint/resume — rebuild of veles/snapshotter.py :: SnapshotterBase,
+SnapshotterToFile and veles.znicz nn_units.py :: NNSnapshotter.
+
+The reference pickles the whole live workflow object graph (SURVEY.md §4.3)
+— code-coupled and fragile.  The rebuild keeps the *semantics* (full
+training-state resume: weights, optimizer momenta, Decision counters,
+Loader shuffle position, PRNG streams) but stores explicit arrays +
+JSON metadata in one compressed ``.npz`` (orbax-style state dict, not
+pickled code).  Resume is ``restore_state(workflow, path)`` into a freshly
+constructed workflow — the analog of ``veles -w snap.pickle.gz``.
+
+Exactness contract (pinned by tests/test_snapshotter.py): resume from the
+epoch-N snapshot and the metric history of epochs N+1.. is bit-identical to
+an uninterrupted run — the reference's snapshot-mid-run/compare trick.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.units import Unit
+
+FORMAT_VERSION = 1
+
+
+# -- state collection -------------------------------------------------------
+def collect_state(workflow) -> tuple[dict, dict]:
+    """-> (arrays, meta): every array the training state needs, plus
+    JSON-able metadata.  Covers forwards' weights/bias, gds' momentum
+    buffers, loader position + shuffle order, decision counters, and all
+    PRNG streams."""
+    step = getattr(workflow, "step", None)
+    if step is not None and getattr(step, "_params", None) is not None:
+        step.sync_to_units()  # device params -> unit Arrays
+    arrays: dict[str, np.ndarray] = {}
+    for i, fwd in enumerate(workflow.forwards):
+        for attr in ("weights", "bias"):
+            arr = getattr(fwd, attr)
+            if arr:
+                arrays[f"forward.{i}.{attr}"] = np.asarray(arr.map_read())
+    for i, gd in enumerate(getattr(workflow, "gds", []) or []):
+        for attr in ("gradient_weights", "gradient_bias"):
+            arr = getattr(gd, attr)
+            if arr:
+                arrays[f"gd.{i}.{attr}"] = np.asarray(arr.map_read())
+    loader_state = workflow.loader.state_dict()
+    for cls, order in loader_state.pop("shuffled").items():
+        arrays[f"loader.shuffled.{cls}"] = np.asarray(order)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "workflow_name": workflow.name,
+        "loader": loader_state,
+        "decision": workflow.decision.state_dict(),
+        "prng": prng.state_dict(),
+    }
+    return arrays, meta
+
+
+def restore_state(workflow, path: str) -> dict:
+    """Load a snapshot into a freshly built workflow (post-``initialize``).
+    Returns the metadata dict."""
+    with np.load(path, allow_pickle=False) as zf:
+        meta = json.loads(str(zf["__meta__"]))
+        if meta["format_version"] != FORMAT_VERSION:
+            raise ValueError(f"snapshot format {meta['format_version']} "
+                             f"!= supported {FORMAT_VERSION}")
+        arrays = {k: zf[k] for k in zf.files if k != "__meta__"}
+    for i, fwd in enumerate(workflow.forwards):
+        for attr in ("weights", "bias"):
+            key = f"forward.{i}.{attr}"
+            if key in arrays:
+                getattr(fwd, attr).map_invalidate()
+                getattr(fwd, attr).mem = arrays[key]
+    for i, gd in enumerate(getattr(workflow, "gds", []) or []):
+        for attr in ("gradient_weights", "gradient_bias"):
+            key = f"gd.{i}.{attr}"
+            if key in arrays:
+                getattr(gd, attr).map_invalidate()
+                getattr(gd, attr).mem = arrays[key]
+    loader_state = dict(meta["loader"])
+    loader_state["shuffled"] = {
+        int(k.rsplit(".", 1)[1]): v for k, v in arrays.items()
+        if k.startswith("loader.shuffled.")}
+    workflow.loader.load_state_dict(loader_state)
+    workflow.decision.load_state_dict(meta["decision"])
+    prng.load_state_dict(meta["prng"])
+    step = getattr(workflow, "step", None)
+    if step is not None and getattr(step, "_params", None) is not None:
+        step._params = step.gather_params()  # re-place restored weights
+    return meta
+
+
+def write_snapshot(path: str, arrays: dict, meta: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, __meta__=np.array(json.dumps(meta)), **arrays)
+    os.replace(tmp, path)  # atomic publish (no torn snapshot on crash)
+
+
+# -- units ------------------------------------------------------------------
+class SnapshotterBase(Unit):
+    """Periodic snapshot unit (reference: SnapshotterBase).
+
+    Sits in the gated side chain after Decision; StandardWorkflow wires
+    ``gate_skip = ~decision.epoch_ended``.  ``interval`` further thins to
+    every k-th epoch; when ``only_improved`` (reference: keyed on
+    Decision.improved) epochs without validation improvement are skipped.
+    """
+
+    def __init__(self, workflow=None, prefix: str = "wf",
+                 directory: Optional[str] = None, interval: int = 1,
+                 only_improved: bool = True, keep_all: bool = False,
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.prefix = prefix
+        self.directory = directory or os.getcwd()
+        self.interval = int(interval)
+        self.only_improved = only_improved
+        self.keep_all = keep_all
+        self.target_workflow = None
+        self.decision = None
+        #: path of the most recent snapshot (reference: destination)
+        self.destination: Optional[str] = None
+        self._epoch_counter = 0
+
+    def link_workflow_state(self, workflow) -> "SnapshotterBase":
+        self.target_workflow = workflow
+        self.decision = workflow.decision
+        return self
+
+    def run(self) -> None:
+        self._epoch_counter += 1
+        if self._epoch_counter % self.interval != 0:
+            return
+        if self.only_improved and not bool(self.decision.improved):
+            return
+        self.export()
+
+    def snapshot_path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"{self.prefix}_{epoch}.npz")
+
+    def export(self) -> None:
+        raise NotImplementedError
+
+
+class SnapshotterToFile(SnapshotterBase):
+    """Writes ``{prefix}_{epoch}.npz`` + ``{prefix}_latest.npz`` symlink
+    (reference: SnapshotterToFile; compression is npz-deflate instead of
+    the reference's gz/bz2/xz-by-extension)."""
+
+    def export(self) -> None:
+        w = self.target_workflow
+        arrays, meta = collect_state(w)
+        epoch = int(meta["loader"]["epoch_number"])
+        path = self.snapshot_path(epoch)
+        os.makedirs(self.directory, exist_ok=True)
+        if not self.keep_all and self.destination and \
+                self.destination != path and \
+                os.path.exists(self.destination):
+            os.unlink(self.destination)
+        write_snapshot(path, arrays, meta)
+        self.destination = path
+        latest = os.path.join(self.directory, f"{self.prefix}_latest.npz")
+        try:
+            if os.path.lexists(latest):
+                os.unlink(latest)
+            os.symlink(os.path.basename(path), latest)
+        except OSError:
+            pass  # symlink-less filesystems: latest pointer is best-effort
+        self.info(f"snapshot -> {path}")
+
+
+class NNSnapshotter(SnapshotterToFile):
+    """SnapshotterToFile + per-layer weight statistics logging (reference:
+    nn_units.py :: NNSnapshotter logs min/max/avg of weights/bias)."""
+
+    def export(self) -> None:
+        super().export()
+        for i, fwd in enumerate(self.target_workflow.forwards):
+            for attr in ("weights", "bias"):
+                arr = getattr(fwd, attr)
+                if arr:
+                    m = arr.map_read()
+                    self.info(
+                        f"{fwd.name}.{attr}: min {m.min():+.4f} "
+                        f"max {m.max():+.4f} avg {m.mean():+.4f}")
