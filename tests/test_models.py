@@ -1,0 +1,61 @@
+"""Tier-2 sample-zoo tests: each models/ entry builds, trains a few epochs
+on TPU/XLA, and its validation metric improves (SURVEY.md §5 tier-2 —
+shrunk configs, seeded determinism)."""
+
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import TPUDevice
+from znicz_tpu.models import autoencoder, cifar_conv, mnist_conv, wine
+
+
+def _train(build, seed=31, **kw):
+    prng.seed_all(seed)
+    w = build(**kw)
+    w.initialize(device=TPUDevice())
+    w.run()
+    assert bool(w.decision.complete)
+    return w.decision.metrics_history
+
+
+def test_wine_sample():
+    hist = _train(wine.build, max_epochs=10)
+    assert hist[-1]["metric_validation"] <= hist[0]["metric_validation"]
+    assert hist[-1]["metric_validation"] <= 3, hist
+
+
+def test_mnist_conv_sample():
+    hist = _train(mnist_conv.build, max_epochs=3, n_train=300, n_valid=100,
+                  minibatch_size=50)
+    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"] or \
+        hist[-1]["metric_validation"] == 0, hist
+
+
+def test_cifar_conv_sample():
+    hist = _train(cifar_conv.build, max_epochs=3, n_train=300, n_valid=100,
+                  minibatch_size=50)
+    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"] or \
+        hist[-1]["metric_validation"] == 0, hist
+
+
+def test_autoencoder_sample():
+    hist = _train(autoencoder.build, max_epochs=4, n_train=200, n_valid=64,
+                  sample_shape=(12, 12, 1))
+    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"], hist
+
+
+def test_run_load_main_shape():
+    """Samples expose the reference's run(load, main) CLI contract."""
+    built = {}
+
+    def load(builder, **kw):
+        prng.seed_all(1)
+        built["w"] = builder(max_epochs=1, n_train=60, n_valid=30,
+                             minibatch_size=10, **kw)
+
+    def main():
+        built["w"].initialize(device=TPUDevice())
+        built["w"].run()
+
+    wine.run(load, main)
+    assert bool(built["w"].decision.complete)

@@ -16,6 +16,28 @@ from znicz_tpu.loader.base import TEST, VALID, TRAIN, register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 
 
+def assemble_classes(means: np.ndarray, n_per_class: dict[int, int],
+                     noise: float, gen) -> tuple:
+    """[test|valid|train]-ordered samples around per-class ``means``
+    ``(n_classes, *sample_shape)`` plus Gaussian noise.  Returns
+    ``(data, labels, class_lengths)`` — the one definition of the split
+    ordering / label tiling every synthetic loader shares."""
+    n_classes = means.shape[0]
+    sample_shape = means.shape[1:]
+    data_parts, label_parts, lengths = [], [], [0, 0, 0]
+    for cls in (TEST, VALID, TRAIN):
+        n = n_per_class.get(cls, 0) * n_classes
+        lengths[cls] = n
+        if n == 0:
+            continue
+        labels = np.tile(np.arange(n_classes), n_per_class[cls])
+        samples = means[labels] + gen.normal(
+            0.0, noise, (n,) + sample_shape).astype(np.float32)
+        data_parts.append(samples.astype(np.float32, copy=False))
+        label_parts.append(labels.astype(np.int32))
+    return (np.concatenate(data_parts), np.concatenate(label_parts), lengths)
+
+
 def make_blobs(n_per_class: dict[int, int], n_classes: int,
                sample_shape: tuple, spread: float = 2.0,
                noise: float = 1.0, stream: str = "synthetic"):
@@ -26,20 +48,9 @@ def make_blobs(n_per_class: dict[int, int], n_classes: int,
     nets converge in a few epochs (what the functional tests pin).
     """
     gen = prng.get(stream)
-    dim = int(np.prod(sample_shape))
-    means = gen.normal(0.0, spread, (n_classes, dim))
-    data_parts, label_parts, lengths = [], [], [0, 0, 0]
-    for cls in (TEST, VALID, TRAIN):
-        n = n_per_class.get(cls, 0) * n_classes
-        lengths[cls] = n
-        if n == 0:
-            continue
-        labels = np.tile(np.arange(n_classes), n_per_class[cls])
-        samples = means[labels] + gen.normal(0.0, noise, (n, dim))
-        data_parts.append(samples.astype(np.float32))
-        label_parts.append(labels.astype(np.int32))
-    data = np.concatenate(data_parts).reshape((-1,) + tuple(sample_shape))
-    return data, np.concatenate(label_parts), lengths
+    shape = tuple(sample_shape)
+    means = gen.normal(0.0, spread, (n_classes,) + shape).astype(np.float32)
+    return assemble_classes(means, n_per_class, noise, gen)
 
 
 @register_loader("synthetic_classifier")
@@ -70,10 +81,33 @@ class SyntheticClassifierLoader(FullBatchLoader):
 
 @register_loader("synthetic_image")
 class SyntheticImageLoader(SyntheticClassifierLoader):
-    """Blob classes rendered as (H, W, C) images — conv-stack test data."""
+    """Class patterns rendered as spatially-smooth (H, W, C) images —
+    conv-stack test/benchmark data.
+
+    Unlike the per-pixel blobs (which are white noise spatially — a conv +
+    pooling stack averages them away), each class mean is a coarse
+    ``(H//4, W//4)`` pattern upsampled to full resolution, so classes have
+    the local spatial structure convolutions exploit."""
 
     def __init__(self, workflow=None, sample_shape=(32, 32, 3), **kwargs) -> None:
+        if len(sample_shape) == 2:
+            sample_shape = tuple(sample_shape) + (1,)
         super().__init__(workflow, sample_shape=sample_shape, **kwargs)
+
+    def load_data(self) -> None:
+        gen = prng.get("synthetic")
+        h, w, c = self.sample_shape
+        ch, cw = max(2, h // 4), max(2, w // 4)
+        coarse = gen.normal(0.0, self.spread,
+                            (self.n_classes, ch, cw, c)).astype(np.float32)
+        ry, rx = -(-h // ch), -(-w // cw)  # ceil
+        means = np.kron(coarse, np.ones((1, ry, rx, 1), np.float32))
+        means = np.ascontiguousarray(means[:, :h, :w, :])
+        data, labels, lengths = assemble_classes(
+            means, self.n_per_class, self.noise, gen)
+        self.original_data.mem = data
+        self.original_labels.mem = labels
+        self.class_lengths = lengths
 
 
 @register_loader("synthetic_regression")

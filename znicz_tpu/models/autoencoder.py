@@ -1,0 +1,42 @@
+"""Conv -> Deconv autoencoder workflow (reference: veles.znicz Deconv
+autoencoder sample, tests/research/ImagenetAE — BASELINE.md config 4).
+
+MSE reconstruction of the input (identity targets); the deconv owns its
+weights (fused-step compatible); the tied-weight variant is available in
+eager mode via Deconv.link_conv_attrs.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+
+def layers(n_kernels: int = 8, k: int = 3):
+    return [
+        {"type": "conv", "->": {"n_kernels": n_kernels, "kx": k, "ky": k},
+         "<-": {"learning_rate": 0.001, "gradient_moment": 0.9}},
+        {"type": "deconv", "->": {"n_kernels": n_kernels, "kx": k, "ky": k,
+                                  "n_channels": 1},
+         "<-": {"learning_rate": 0.001, "gradient_moment": 0.9}},
+    ]
+
+
+def build(max_epochs: int = 10, minibatch_size: int = 50,
+          sample_shape=(16, 16, 1), n_train: int = 500, n_valid: int = 150,
+          n_kernels: int = 8, fused: bool = True, mesh=None,
+          snapshotter_config: dict | None = None) -> StandardWorkflow:
+    lay = layers(n_kernels)
+    lay[-1]["->"]["n_channels"] = sample_shape[-1]
+    return StandardWorkflow(
+        name="ConvAE", layers=lay, loss_function="mse",
+        loader_name="synthetic_regression",
+        loader_config={"sample_shape": tuple(sample_shape), "identity": True,
+                       "n_train": n_train, "n_valid": n_valid,
+                       "minibatch_size": minibatch_size},
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+
+
+def run(load, main):
+    load(build)
+    main()

@@ -1,0 +1,53 @@
+"""MNIST convolutional workflow (reference: veles.znicz samples/MNIST conv
+config — BASELINE.md config 2 "MNIST-conv to 99%").
+
+Declarative StandardWorkflow description; data is the seeded synthetic
+MNIST stand-in by default (no egress in the sandbox — SURVEY.md §5
+fixtures), a real-MNIST loader drops in via ``loader_name``.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "conv_relu", "->": {"n_kernels": 32, "kx": 5, "ky": 5,
+                                 "padding": (2, 2, 2, 2)},
+     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+            "weights_decay": 5e-4}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "conv_relu", "->": {"n_kernels": 64, "kx": 5, "ky": 5,
+                                 "padding": (2, 2, 2, 2)},
+     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+            "weights_decay": 5e-4}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "all2all_relu", "->": {"output_sample_shape": 128},
+     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+            "weights_decay": 5e-4}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+            "weights_decay": 5e-4}},
+]
+
+
+def build(max_epochs: int = 10, minibatch_size: int = 100,
+          n_train: int = 2000, n_valid: int = 500, fused: bool = True,
+          mesh=None, loader_name: str = "synthetic_image",
+          loader_config: dict | None = None,
+          snapshotter_config: dict | None = None) -> StandardWorkflow:
+    cfg = {"n_classes": 10, "sample_shape": (28, 28, 1),
+           "n_train": n_train, "n_valid": n_valid,
+           "minibatch_size": minibatch_size, "spread": 2.5, "noise": 1.0}
+    cfg.update(loader_config or {})
+    return StandardWorkflow(
+        name="MnistConv", layers=LAYERS, loss_function="softmax",
+        loader_name=loader_name, loader_config=cfg,
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+
+
+def run(load, main):
+    """Reference sample entry shape: ``run(load, main)`` driven by the CLI
+    (veles <workflow.py> <config.py>)."""
+    load(build)
+    main()

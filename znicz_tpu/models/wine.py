@@ -1,0 +1,34 @@
+"""Wine classification workflow (reference: veles.znicz samples/Wine/
+wine.py — the smallest sample: 13-feature vectors, 3 classes, one hidden
+layer; the reference's "hello world" after MNIST)."""
+
+from __future__ import annotations
+
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.3, "gradient_moment": 0.5}},
+    {"type": "softmax", "->": {"output_sample_shape": 3},
+     "<-": {"learning_rate": 0.3, "gradient_moment": 0.5}},
+]
+
+
+def build(max_epochs: int = 20, minibatch_size: int = 10,
+          n_train: int = 150, n_valid: int = 30, fused: bool = True,
+          mesh=None, snapshotter_config: dict | None = None
+          ) -> StandardWorkflow:
+    return StandardWorkflow(
+        name="Wine", layers=LAYERS, loss_function="softmax",
+        loader_name="synthetic_classifier",
+        loader_config={"n_classes": 3, "sample_shape": (13,),
+                       "n_train": n_train, "n_valid": n_valid,
+                       "minibatch_size": minibatch_size, "spread": 3.0,
+                       "noise": 1.0},
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+
+
+def run(load, main):
+    load(build)
+    main()

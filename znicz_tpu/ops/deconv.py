@@ -1,0 +1,92 @@
+"""Transposed convolution (deconv) forward/backward — rebuild of the
+reference's deconv.{cl,cu} / gradient_descent_deconv kernels (SURVEY.md
+§3.2 "transposed-conv gather/scatter pair").
+
+A Deconv is the exact adjoint of a Conv with the same geometry: its input
+has the conv's *output* shape ``(n, oh, ow, n_kernels)``, its output the
+conv's *input* shape ``(n, h, w, c)``, sharing the HWIO weights.
+
+- numpy path: the patch-GEMM + overlap-add ``col2im`` oracle (what the
+  reference's scatter kernel does with atomics);
+- jnp path: one ``lax.conv_general_dilated`` with ``lhs_dilation`` = the
+  conv's stride and the spatially-flipped, io-swapped kernel — the exact
+  adjoint, expressed as a native XLA conv (MXU path) that traces cleanly
+  under jit/shard_map/autograd (a ``jax.vjp``-based formulation would not:
+  the cotangent's varying-axis type must match the primal's under
+  shard_map).
+
+``min_output_size`` gives the canonical inverse spatial size
+``(o-1)*stride + k - pad0 - pad1`` (the conv input size that produces ``o``
+outputs with nothing left over).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from znicz_tpu.ops.conv import (_DIMNUMS, col2im, forward_linear, im2col,
+                                normalize_geometry)
+
+
+def min_output_size(o: int, k: int, stride: int, pad0: int, pad1: int) -> int:
+    return (o - 1) * stride + k - pad0 - pad1
+
+
+def output_shape_for(in_shape, weights_shape, sliding, padding):
+    """Deconv output shape (the paired conv's input shape)."""
+    n, oh, ow, nk = in_shape
+    ky, kx, c, nk_w = weights_shape
+    if nk != nk_w:
+        raise ValueError(f"input channels {nk} != weight kernels {nk_w}")
+    ky, kx, sy, sx, pt, pb, pl, pr = normalize_geometry(
+        kx, ky, sliding, padding)
+    return (n, min_output_size(oh, ky, sy, pt, pb),
+            min_output_size(ow, kx, sx, pl, pr), c)
+
+
+def forward(xp, x, weights, sliding, padding, out_shape):
+    """x ``(n, oh, ow, nk)``, HWIO weights -> ``out_shape`` (n, h, w, c)."""
+    ky, kx, c, nk = weights.shape
+    ky, kx, sy, sx, pt, pb, pl, pr = normalize_geometry(
+        kx, ky, sliding, padding)
+    if xp is np:
+        n, oh, ow, _ = x.shape
+        e = x.reshape(n * oh * ow, nk)
+        cols = (e @ weights.reshape(-1, nk).T).reshape(
+            n, oh, ow, ky, kx, c)
+        return col2im(np, cols, out_shape, ky, kx, sy, sx, pt, pb, pl, pr)
+    n, oh, ow, _ = x.shape
+    h, w_out = out_shape[1], out_shape[2]
+    # padding that makes the dilated conv produce exactly (h, w_out):
+    # b may go negative (XLA negative padding) when out_shape crops rows
+    a_h, b_h = ky - 1 - pt, h + pt - (oh - 1) * sy - 1
+    a_w, b_w = kx - 1 - pl, w_out + pl - (ow - 1) * sx - 1
+    w_t = weights[::-1, ::-1].transpose(0, 1, 3, 2)  # flip + io-swap: HWOI'
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=((a_h, b_h), (a_w, b_w)),
+        lhs_dilation=(sy, sx), dimension_numbers=_DIMNUMS)
+
+
+def backward(xp, x, weights, err_output, sliding, padding):
+    """Returns ``(err_input, grad_weights)``: err_input is the forward conv
+    of err_output (adjoint of the adjoint); grad_weights the patch GEMM
+    with input/error roles swapped relative to conv backward."""
+    ky, kx, c, nk = weights.shape
+    ky, kx, sy, sx, pt, pb, pl, pr = normalize_geometry(
+        kx, ky, sliding, padding)
+    if xp is np:
+        err_input = forward_linear(np, err_output, weights, None,
+                                   (sy, sx), (pt, pb, pl, pr))
+        cols, oh, ow = im2col(np, err_output, ky, kx, sy, sx, pt, pb, pl, pr)
+        n = x.shape[0]
+        grad_w = (cols.reshape(n * oh * ow, -1).T @
+                  x.reshape(n * oh * ow, nk)).reshape(weights.shape)
+        return err_input, grad_w
+    fwd = lambda xx, ww: forward(jnp, xx, ww, (sy, sx),   # noqa: E731
+                                 (pt, pb, pl, pr), err_output.shape)
+    _, vjp = jax.vjp(fwd, x, weights)
+    return vjp(err_output)

@@ -70,18 +70,29 @@ def restore_state(workflow, path: str) -> dict:
             raise ValueError(f"snapshot format {meta['format_version']} "
                              f"!= supported {FORMAT_VERSION}")
         arrays = {k: zf[k] for k in zf.files if k != "__meta__"}
+    # strict key/shape matching: a snapshot from a different architecture
+    # must fail loudly, never silently resume from partly-random weights
+    targets: dict[str, object] = {}
     for i, fwd in enumerate(workflow.forwards):
         for attr in ("weights", "bias"):
-            key = f"forward.{i}.{attr}"
-            if key in arrays:
-                getattr(fwd, attr).map_invalidate()
-                getattr(fwd, attr).mem = arrays[key]
+            if getattr(fwd, attr):
+                targets[f"forward.{i}.{attr}"] = getattr(fwd, attr)
     for i, gd in enumerate(getattr(workflow, "gds", []) or []):
         for attr in ("gradient_weights", "gradient_bias"):
-            key = f"gd.{i}.{attr}"
-            if key in arrays:
-                getattr(gd, attr).map_invalidate()
-                getattr(gd, attr).mem = arrays[key]
+            if getattr(gd, attr):
+                targets[f"gd.{i}.{attr}"] = getattr(gd, attr)
+    param_keys = {k for k in arrays if not k.startswith("loader.")}
+    if param_keys != set(targets):
+        raise ValueError(
+            f"snapshot/workflow architecture mismatch: snapshot-only keys "
+            f"{sorted(param_keys - set(targets))}, workflow-only keys "
+            f"{sorted(set(targets) - param_keys)}")
+    for key, arr in targets.items():
+        if tuple(arrays[key].shape) != tuple(arr.shape):
+            raise ValueError(f"{key}: snapshot shape {arrays[key].shape} "
+                             f"!= workflow shape {arr.shape}")
+        arr.map_invalidate()
+        arr.mem = arrays[key]
     loader_state = dict(meta["loader"])
     loader_state["shuffled"] = {
         int(k.rsplit(".", 1)[1]): v for k, v in arrays.items()
@@ -159,11 +170,13 @@ class SnapshotterToFile(SnapshotterBase):
         epoch = int(meta["loader"]["epoch_number"])
         path = self.snapshot_path(epoch)
         os.makedirs(self.directory, exist_ok=True)
+        write_snapshot(path, arrays, meta)
+        # prune only after the new snapshot is durably published — a failed
+        # write must never leave the run without a resumable checkpoint
         if not self.keep_all and self.destination and \
                 self.destination != path and \
                 os.path.exists(self.destination):
             os.unlink(self.destination)
-        write_snapshot(path, arrays, meta)
         self.destination = path
         latest = os.path.join(self.directory, f"{self.prefix}_latest.npz")
         try:

@@ -9,5 +9,6 @@ fwd<->gd registry is fully populated (StandardWorkflow's layer-type lookup
 depends on it).
 """
 
-from znicz_tpu.units import (activation, all2all, conv, dropout,  # noqa: F401
-                             gd, gd_conv, gd_pooling, normalization, pooling)
+from znicz_tpu.units import (activation, all2all, conv, deconv,  # noqa: F401
+                             dropout, gd, gd_conv, gd_deconv, gd_pooling,
+                             normalization, pooling)

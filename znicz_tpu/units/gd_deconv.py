@@ -1,0 +1,84 @@
+"""Deconvolution gradient unit — rebuild of veles.znicz gd_deconv.py ::
+GDDeconv.
+
+err_input is the *forward* conv of err_output (adjoint of the transposed
+conv); grad_weights the patch GEMM with input/error roles swapped relative
+to GDConv (znicz_tpu.ops.deconv.backward).  No bias (matches Deconv).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.ops import deconv as deconv_ops, sgd
+from znicz_tpu.units.nn_units import GradientDescentBase
+
+
+class GDDeconv(GradientDescentBase):
+    """Reference: gd_deconv.py :: GDDeconv."""
+
+    MAPPING = {"deconv"}
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.sliding = (1, 1)
+        self.padding = (0, 0, 0, 0)
+
+    def link_from_forward(self, forward) -> "GDDeconv":
+        self.link_attrs(forward, "input", "output", "weights")
+        self.sliding = forward.sliding
+        self.padding = forward.padding
+        return self
+
+    def _common_init(self, **kwargs) -> None:
+        super()._common_init(**kwargs)
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(shape=self.input.shape)
+        self.init_array(self.err_input, self.err_output,
+                        self.gradient_weights)
+
+    def _step(self, xp, x, w, err_out, vel_w, batch_size):
+        err_in, grad_w = deconv_ops.backward(
+            xp, x, w, err_out, self.sliding, self.padding)
+        if not self.need_err_input:
+            err_in = None
+        if self.apply_gradient:
+            w, vel_w = sgd.update(xp, w, grad_w, vel_w, self.learning_rate,
+                                  self.weights_decay, self.l1_vs_l2,
+                                  self.gradient_moment, batch_size)
+        return err_in, w, vel_w
+
+    def numpy_run(self) -> None:
+        err_in, w, vel_w = self._step(
+            np, self.input.mem, self.weights.mem, self.err_output.mem,
+            self.gradient_weights.mem,
+            self.current_batch_size(self.err_output))
+        if err_in is not None:
+            self.err_input.map_invalidate()
+            self.err_input.mem = err_in
+        self.weights.map_invalidate()
+        self.weights.mem = w
+        self.gradient_weights.map_invalidate()
+        self.gradient_weights.mem = vel_w
+
+    def xla_init(self) -> None:
+        def fn(x, w, err_out, vel_w, batch_size):
+            return self._step(jnp, x, w, err_out, vel_w, batch_size)
+
+        self._xla_fn = jax.jit(fn)
+
+    def xla_run(self) -> None:
+        for arr in (self.input, self.weights, self.err_output,
+                    self.gradient_weights):
+            arr.unmap()
+        err_in, w, vel_w = self._xla_fn(
+            self.input.devmem, self.weights.devmem, self.err_output.devmem,
+            self.gradient_weights.devmem,
+            self.current_batch_size(self.err_output))
+        if err_in is not None:
+            self.err_input.set_devmem(err_in)
+        self.weights.set_devmem(w)
+        self.gradient_weights.set_devmem(vel_w)
