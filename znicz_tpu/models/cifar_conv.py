@@ -2,8 +2,8 @@
 cifar.py — the ConvRELU benchmark workflow in BASELINE.json).
 
 Conv/pool stack + dropout head, declarative StandardWorkflow form;
-synthetic CIFAR-shaped data by default (SURVEY.md §5 fixtures).  (LRN is
-exercised by the AlexNet workflow, models/alexnet.py, as in the reference.)
+synthetic CIFAR-shaped data by default (SURVEY.md §5 fixtures).  (LRN
+belongs to AlexNet-style stacks, as in the reference.)
 """
 
 from __future__ import annotations

@@ -1,0 +1,92 @@
+"""Divergence rollback — rebuild of veles.znicz nn_rollback.py ::
+NNRollback.
+
+Epoch-gated watchdog: on validation improvement it stores host copies of
+all weights/bias/momenta ("last good"); when training diverges (NaN/inf
+metric, or ``fail_iterations`` epochs without improvement) it restores the
+last-good state and multiplies every gd learning rate by ``lr_cut``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from znicz_tpu.core.units import Unit
+
+
+class NNRollback(Unit):
+    """Reference: nn_rollback.py :: NNRollback."""
+
+    def __init__(self, workflow=None, lr_cut: float = 0.5,
+                 fail_iterations: int = 5, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.lr_cut = float(lr_cut)
+        self.fail_iterations = int(fail_iterations)
+        self.target_workflow = None
+        self.decision = None
+        self._good: dict[str, np.ndarray] = {}
+        self._bad_epochs = 0
+        self.rollback_count = 0
+
+    def link_workflow_state(self, workflow) -> "NNRollback":
+        self.target_workflow = workflow
+        self.decision = workflow.decision
+        return self
+
+    # -- state capture (same array inventory as the snapshotter) ------------
+    def _param_arrays(self):
+        w = self.target_workflow
+        for i, fwd in enumerate(w.forwards):
+            for attr in ("weights", "bias"):
+                if getattr(fwd, attr):
+                    yield f"forward.{i}.{attr}", getattr(fwd, attr)
+        for i, gd in enumerate(getattr(w, "gds", []) or []):
+            for attr in ("gradient_weights", "gradient_bias"):
+                if getattr(gd, attr):
+                    yield f"gd.{i}.{attr}", getattr(gd, attr)
+
+    def _store_good(self) -> None:
+        step = getattr(self.target_workflow, "step", None)
+        if step is not None and getattr(step, "_params", None) is not None:
+            step.sync_to_units()
+        self._good = {k: np.array(arr.map_read(), copy=True)
+                      for k, arr in self._param_arrays()}
+
+    def _restore_good(self) -> None:
+        for k, arr in self._param_arrays():
+            if k in self._good:
+                arr.map_invalidate()
+                arr.mem = self._good[k].copy()
+        step = getattr(self.target_workflow, "step", None)
+        if step is not None and getattr(step, "_params", None) is not None:
+            step._params = step.gather_params()
+
+    def _metric_is_finite(self) -> bool:
+        for m in self.decision.epoch_metrics:
+            if m is not None and not math.isfinite(m):
+                return False
+        return True
+
+    def run(self) -> None:
+        dec = self.decision
+        if not bool(dec.epoch_ended):
+            return
+        if bool(dec.improved) and self._metric_is_finite():
+            self._store_good()
+            self._bad_epochs = 0
+            return
+        self._bad_epochs += 1
+        if not self._metric_is_finite() or \
+                self._bad_epochs >= self.fail_iterations:
+            if self._good:
+                self._restore_good()
+            for gd in getattr(self.target_workflow, "gds", []) or []:
+                gd.learning_rate = float(gd.learning_rate) * self.lr_cut
+                gd.learning_rate_bias = \
+                    float(gd.learning_rate_bias) * self.lr_cut
+            self._bad_epochs = 0
+            self.rollback_count += 1
+            self.info(f"rollback #{self.rollback_count}: restored last-good "
+                      f"weights, lr cut by {self.lr_cut}")
