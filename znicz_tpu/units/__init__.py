@@ -9,6 +9,9 @@ fwd<->gd registry is fully populated (StandardWorkflow's layer-type lookup
 depends on it).
 """
 
-from znicz_tpu.units import (activation, all2all, conv, deconv,  # noqa: F401
-                             dropout, gd, gd_conv, gd_deconv, gd_pooling,
-                             normalization, pooling)
+from znicz_tpu.units import (activation, all2all, conv, cutter,  # noqa: F401
+                             deconv, dropout, gd, gd_conv, gd_deconv,
+                             gd_pooling, kohonen, lr_adjust,
+                             mean_disp_normalizer, nn_rollback,
+                             normalization, pooling, rbm,
+                             resizable_all2all, weights_zerofilling)

@@ -1,0 +1,202 @@
+"""Kohonen SOM units — rebuild of veles.znicz kohonen.py :: KohonenBase,
+KohonenForward, KohonenTrainer (+ the sample's decision logic).
+
+Unsupervised winner-take-all with Gaussian neighborhood decay; no gradient
+pair (SURVEY.md §3.1).  ``KohonenTrainer`` owns the ``(sy*sx, n_input)``
+weights and performs the batched update (znicz_tpu.ops.kohonen);
+``KohonenForward`` emits winner indices (and hit counts) using the shared
+weights.  ``KohonenDecision`` stops on max_epochs or when the epoch weight
+delta stabilizes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.ops import kohonen as k_ops
+from znicz_tpu.units.decision import DecisionBase
+
+
+class KohonenBase(AcceleratedUnit):
+    """Shared geometry (reference: kohonen.py :: KohonenBase)."""
+
+    def __init__(self, workflow=None, shape=(8, 8), **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.sy, self.sx = int(shape[0]), int(shape[1])
+        self.input = Array()
+        self.weights = Array()
+
+    @property
+    def n_neurons(self) -> int:
+        return self.sy * self.sx
+
+    def _flat_input(self, mem):
+        return mem.reshape(mem.shape[0], -1)
+
+
+class KohonenTrainer(KohonenBase):
+    """Reference: kohonen.py :: KohonenTrainer.
+
+    ``gradient_decay``/``radius_decay``: per-epoch multiplicative decay of
+    the learning rate and neighborhood radius (reference semantics of the
+    time-decaying schedules)."""
+
+    def __init__(self, workflow=None, shape=(8, 8), alpha: float = 0.5,
+                 alpha_min: float = 0.01, gradient_decay: float = 0.95,
+                 radius: float = None, radius_min: float = 0.5,
+                 radius_decay: float = 0.95, **kwargs) -> None:
+        super().__init__(workflow, shape=shape, **kwargs)
+        self.alpha0 = float(alpha)
+        self.alpha_min = float(alpha_min)
+        self.gradient_decay = float(gradient_decay)
+        self.radius0 = float(radius if radius is not None
+                             else max(self.sy, self.sx) / 2.0)
+        self.radius_min = float(radius_min)
+        self.radius_decay = float(radius_decay)
+        self.epoch_number = 0            # data-linked from the loader
+        self.winners = Array()
+        self._coords_np = None
+
+    # current schedule values (read by tests/plotters)
+    @property
+    def alpha(self) -> float:
+        return max(self.alpha0 * self.gradient_decay ** int(self.epoch_number),
+                   self.alpha_min)
+
+    @property
+    def radius(self) -> float:
+        return max(self.radius0 * self.radius_decay ** int(self.epoch_number),
+                   self.radius_min)
+
+    def _common_init(self, **kwargs) -> None:
+        dim = int(np.prod(self.input.shape[1:]))
+        if not self.weights:
+            self.weights.mem = prng.get().normal(
+                0.0, 0.1, (self.n_neurons, dim))
+        if not self.winners or len(self.winners) != self.input.shape[0]:
+            self.winners.reset(shape=(self.input.shape[0],), dtype=np.int32)
+        self._coords_np = np.asarray(k_ops.grid_coords(np, self.sy, self.sx))
+        self.init_array(self.input, self.weights, self.winners)
+
+    def numpy_run(self) -> None:
+        x = self._flat_input(self.input.mem)
+        mask = self._mask(x.shape[0])
+        new_w, idx = k_ops.update(np, x, self.weights.mem, self._coords_np,
+                                  self.alpha, self.radius, mask)
+        self.weights.map_invalidate()
+        self.weights.mem = new_w
+        self.winners.map_invalidate()
+        self.winners.mem = idx.astype(np.int32)
+
+    def _mask(self, n):
+        bs = self.current_batch_size(self.input)
+        if bs >= n:
+            return None
+        return (np.arange(n) < bs)
+
+    def xla_init(self) -> None:
+        coords = jnp.asarray(self._coords_np)
+
+        def fn(x, w, alpha, radius, bs):
+            mask = jnp.arange(x.shape[0]) < bs
+            new_w, idx = k_ops.update(jnp, x, w, coords, alpha, radius, mask)
+            return new_w, idx.astype(jnp.int32)
+
+        self._xla_fn = jax.jit(fn)
+
+    def xla_run(self) -> None:
+        self.input.unmap()
+        self.weights.unmap()
+        x = self.input.devmem
+        new_w, idx = self._xla_fn(
+            x.reshape(x.shape[0], -1), self.weights.devmem,
+            self.alpha, self.radius,
+            self.current_batch_size(self.input))
+        self.weights.set_devmem(new_w)
+        self.winners.set_devmem(idx)
+
+
+class KohonenForward(KohonenBase):
+    """Reference: kohonen.py :: KohonenForward — winner index per sample
+    (+ hit counts for the SOM plotters); weights linked from the trainer."""
+
+    def __init__(self, workflow=None, shape=(8, 8), compute_hits: bool = True,
+                 **kwargs) -> None:
+        super().__init__(workflow, shape=shape, **kwargs)
+        self.output = Array()
+        self.compute_hits = compute_hits
+        self.hits = None
+
+    def _common_init(self, **kwargs) -> None:
+        if not self.output or len(self.output) != self.input.shape[0]:
+            self.output.reset(shape=(self.input.shape[0],), dtype=np.int32)
+        if self.compute_hits and self.hits is None:
+            self.hits = np.zeros(self.n_neurons, np.int64)
+        self.init_array(self.input, self.weights, self.output)
+
+    def numpy_run(self) -> None:
+        x = self._flat_input(self.input.mem)
+        idx = k_ops.winners(np, x, self.weights.mem)
+        self.output.map_invalidate()
+        self.output.mem = idx.astype(np.int32)
+        if self.compute_hits:
+            bs = self.current_batch_size(self.input)
+            self.hits += np.bincount(idx[:bs], minlength=self.n_neurons)
+
+    def xla_init(self) -> None:
+        self._xla_fn = jax.jit(
+            lambda x, w: k_ops.winners(jnp, x, w).astype(jnp.int32))
+
+    def xla_run(self) -> None:
+        self.input.unmap()
+        self.weights.unmap()
+        x = self.input.devmem
+        idx = self._xla_fn(x.reshape(x.shape[0], -1), self.weights.devmem)
+        self.output.set_devmem(idx)
+        if self.compute_hits:
+            bs = self.current_batch_size(self.input)
+            self.hits += np.bincount(np.asarray(idx)[:bs],
+                                     minlength=self.n_neurons)
+
+
+class KohonenDecision(DecisionBase):
+    """Epoch bookkeeping for SOM training: metric is the epoch's weight
+    movement ``|ΔW|/|W|``; stops on max_epochs or when movement falls
+    below ``min_delta`` (reference sample's stop logic)."""
+
+    def __init__(self, workflow=None, min_delta: float = 1e-4,
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.min_delta = float(min_delta)
+        self.trainer = None
+        self._epoch_start_w = None
+        self.weights_delta = 0.0
+
+    def accumulate(self, cls: int) -> None:
+        if self._epoch_start_w is None:
+            self._epoch_start_w = self.trainer.weights.map_read().copy()
+
+    def finalize_class(self, cls: int) -> float:
+        w = self.trainer.weights.map_read()
+        denom = max(float(np.abs(self._epoch_start_w).sum()), 1e-12)
+        self.weights_delta = float(
+            np.abs(w - self._epoch_start_w).sum()) / denom
+        return self.weights_delta
+
+    def reset_epoch(self) -> None:
+        self._epoch_start_w = None
+
+    def run(self) -> None:
+        super().run()
+        if bool(self.epoch_ended) and self.weights_delta < self.min_delta:
+            self.complete.set(True)
+
+    def on_epoch_logged(self) -> None:
+        self.info(f"epoch {int(self.epoch_number)}: weights delta "
+                  f"{self.weights_delta:.6f}")
