@@ -1,11 +1,14 @@
 """Benchmark harness — emits ONE JSON line for the driver.
 
-Current flagship benchmark: fused training-step throughput (samples/sec)
-on the largest model the framework has landed; upgrades to the ImageNet
-AlexNet workflow (BASELINE.md config 3) as soon as the conv stack is in.
+Flagship benchmark (BASELINE.md config 3 / north star): AlexNet fused
+training-step throughput, samples/sec on one chip — forward + backward +
+SGD update of the full 227x227x3 ImageNet geometry, batch 128.
 ``vs_baseline`` is 1.0 by convention: the reference published no numbers
 (BASELINE.json :: published == {}), so the driver-recorded history of this
 metric across rounds IS the baseline trend.
+
+Falls back to the FC benchmark if the conv stack cannot run, and says so in
+the JSON (``fallback_reason``) so a flagship regression is never silent.
 """
 
 import json
@@ -16,8 +19,51 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _throughput(workflow, x, labels, steps: int, warmup: int) -> float:
+    """Shared timing protocol: warmed, device-synced samples/sec of the
+    fused training step on fixed host inputs."""
+    import numpy as np
+    import jax
+    from znicz_tpu.core import prng
+
+    step = workflow.step
+    batch = x.shape[0]
+    mask = np.ones(batch, bool)
+    params = step._params
+    hyper = step.hyper_params()
+    key = prng.get().key()
+    for _ in range(warmup):
+        params, _ = step._train_fn(params, hyper, key, x, labels, mask)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, _ = step._train_fn(params, hyper, key, x, labels, mask)
+    jax.block_until_ready(params)
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def bench_alexnet_train(batch: int = 128, steps: int = 20, warmup: int = 3):
+    """Samples/sec of the fused AlexNet training step on one chip."""
+    import numpy as np
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models.alexnet import build
+
+    prng.seed_all(7)
+    # loader dataset is minimal (8 samples): the bench feeds _train_fn its
+    # own fixed batch below; the loader only has to satisfy initialize()
+    w = build(max_epochs=1, minibatch_size=batch, n_classes=1000,
+              input_size=227, n_train=8, n_valid=0,
+              loader_config={"n_classes": 8})
+    w.initialize(device=TPUDevice())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 227, 227, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, batch).astype(np.int32)
+    return _throughput(w, x, labels, steps, warmup)
+
+
 def bench_fc_train(batch: int = 1024, steps: int = 50, warmup: int = 5):
-    """Samples/sec of the fused FC training step on one chip."""
+    """Fallback: samples/sec of the fused FC training step."""
     import numpy as np
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
@@ -27,33 +73,22 @@ def bench_fc_train(batch: int = 1024, steps: int = 50, warmup: int = 5):
     w = build_fused(max_epochs=1, layers=(4096, 4096), minibatch_size=batch,
                     n_train=2 * batch, n_valid=0)
     w.initialize(device=TPUDevice())
-    step = w.step
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch, 28, 28)).astype(np.float32)
     labels = rng.integers(0, 10, batch).astype(np.int32)
-    mask = np.ones(batch, bool)
-    params = step._params
-    hyper = step.hyper_params()
-    for _ in range(warmup):
-        params, metrics = step._train_fn(params, hyper, x, labels, mask)
-    import jax
-    jax.block_until_ready(params)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, metrics = step._train_fn(params, hyper, x, labels, mask)
-    jax.block_until_ready(params)
-    dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return _throughput(w, x, labels, steps, warmup)
 
 
 def main():
-    sps = bench_fc_train()
-    print(json.dumps({
-        "metric": "mnist_fc4096_train_samples_per_sec_per_chip",
-        "value": round(sps, 1),
-        "unit": "samples/sec",
-        "vs_baseline": 1.0,
-    }))
+    result = {"unit": "samples/sec", "vs_baseline": 1.0}
+    try:
+        result["value"] = round(bench_alexnet_train(), 1)
+        result["metric"] = "alexnet_b128_train_samples_per_sec_per_chip"
+    except Exception as exc:  # noqa: BLE001
+        result["value"] = round(bench_fc_train(), 1)
+        result["metric"] = "mnist_fc4096_train_samples_per_sec_per_chip"
+        result["fallback_reason"] = f"alexnet bench failed: {exc!r}"[:200]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

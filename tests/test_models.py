@@ -6,7 +6,8 @@ import pytest
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.backends import TPUDevice
-from znicz_tpu.models import autoencoder, cifar_conv, mnist_conv, wine
+from znicz_tpu.models import (alexnet, autoencoder, cifar_conv, mnist_conv,
+                              wine)
 
 
 def _train(build, seed=31, **kw):
@@ -42,6 +43,17 @@ def test_autoencoder_sample():
     hist = _train(autoencoder.build, max_epochs=4, n_train=200, n_valid=64,
                   sample_shape=(12, 12, 1))
     assert hist[-1]["metric_validation"] < hist[0]["metric_validation"], hist
+
+
+def test_alexnet_sample():
+    """Shrunk AlexNet (67px input, soft dropout, separable data) must
+    collapse validation error within 5 epochs — the north-star workflow's
+    functional pin (BASELINE.md config 3)."""
+    hist = _train(alexnet.build, seed=1, max_epochs=5, minibatch_size=50,
+                  n_classes=10, input_size=67, n_train=300, n_valid=100,
+                  lr=0.003, dropout=0.2, loader_config={"spread": 2.0})
+    assert hist[-1]["metric_validation"] <= 0.2 * hist[0]["metric_validation"], \
+        hist
 
 
 def test_run_load_main_shape():

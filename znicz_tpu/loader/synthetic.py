@@ -35,6 +35,10 @@ def assemble_classes(means: np.ndarray, n_per_class: dict[int, int],
             0.0, noise, (n,) + sample_shape).astype(np.float32)
         data_parts.append(samples.astype(np.float32, copy=False))
         label_parts.append(labels.astype(np.int32))
+    if not data_parts:
+        raise ValueError(
+            f"empty synthetic dataset: n_per_class={n_per_class} over "
+            f"{n_classes} classes (n_train/n_valid must be >= n_classes)")
     return (np.concatenate(data_parts), np.concatenate(label_parts), lengths)
 
 

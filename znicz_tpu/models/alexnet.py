@@ -1,0 +1,80 @@
+"""AlexNet ImageNet workflow — rebuild of the reference's ImageNet AlexNet
+sample (veles.znicz tests/research/AlexNet imagenet workflow; BASELINE.md
+config 3, the north-star benchmark).
+
+Canonical geometry (Krizhevsky et al. 2012, as the reference configures
+it): 227x227x3 input; conv 96/11x11 s4 -> LRN -> pool3 s2 -> conv 256/5x5
+pad2 -> LRN -> pool -> conv 384 -> conv 384 -> conv 256 -> pool -> fc 4096
+(dropout) -> fc 4096 (dropout) -> softmax 1000.
+
+Input normalization: the reference's ImageNet pipeline runs
+MeanDispNormalizer over the loader output; here the synthetic loader
+already produces zero-centered unit-ish data, and the standalone
+MeanDispNormalizer unit covers the real-data path.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+
+def layers(n_classes: int = 1000, lr: float = 0.01, moment: float = 0.9,
+           wd: float = 5e-4, dropout: float = 0.5):
+    hyper = {"learning_rate": lr, "gradient_moment": moment,
+             "weights_decay": wd}
+    return [
+        {"type": "conv_str", "->": {"n_kernels": 96, "kx": 11, "ky": 11,
+                                    "sliding": (4, 4)}, "<-": dict(hyper)},
+        {"type": "norm", "->": {"alpha": 1e-4, "beta": 0.75, "k": 2.0,
+                                "n": 5}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"type": "conv_str", "->": {"n_kernels": 256, "kx": 5, "ky": 5,
+                                    "padding": (2, 2, 2, 2)},
+         "<-": dict(hyper)},
+        {"type": "norm", "->": {"alpha": 1e-4, "beta": 0.75, "k": 2.0,
+                                "n": 5}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"type": "conv_str", "->": {"n_kernels": 384, "kx": 3, "ky": 3,
+                                    "padding": (1, 1, 1, 1)},
+         "<-": dict(hyper)},
+        {"type": "conv_str", "->": {"n_kernels": 384, "kx": 3, "ky": 3,
+                                    "padding": (1, 1, 1, 1)},
+         "<-": dict(hyper)},
+        {"type": "conv_str", "->": {"n_kernels": 256, "kx": 3, "ky": 3,
+                                    "padding": (1, 1, 1, 1)},
+         "<-": dict(hyper)},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"type": "dropout", "->": {"dropout_ratio": dropout}},
+        {"type": "all2all_str", "->": {"output_sample_shape": 4096},
+         "<-": dict(hyper)},
+        {"type": "dropout", "->": {"dropout_ratio": dropout}},
+        {"type": "all2all_str", "->": {"output_sample_shape": 4096},
+         "<-": dict(hyper)},
+        {"type": "softmax", "->": {"output_sample_shape": n_classes},
+         "<-": dict(hyper)},
+    ]
+
+
+def build(max_epochs: int = 1, minibatch_size: int = 128,
+          n_classes: int = 1000, input_size: int = 227,
+          n_train: int = 1000, n_valid: int = 0, lr: float = 0.01,
+          dropout: float = 0.5, fused: bool = True, mesh=None,
+          loader_config: dict | None = None,
+          snapshotter_config: dict | None = None) -> StandardWorkflow:
+    cfg = {"n_classes": min(n_classes, 50),
+           "sample_shape": (input_size, input_size, 3),
+           "n_train": n_train, "n_valid": n_valid,
+           "minibatch_size": minibatch_size, "spread": 1.0, "noise": 0.5}
+    cfg.update(loader_config or {})
+    return StandardWorkflow(
+        name="AlexNet",
+        layers=layers(n_classes=n_classes, lr=lr, dropout=dropout),
+        loss_function="softmax", loader_name="synthetic_image",
+        loader_config=cfg,
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+
+
+def run(load, main):
+    load(build)
+    main()
