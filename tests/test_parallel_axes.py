@@ -1,0 +1,155 @@
+"""Multi-axis parallelism tests on the virtual 8-device CPU mesh
+(SURVEY.md §5 tier-3): each strategy is pinned exactly equal to its
+single-device dense formulation — ring attention (sp), Megatron column/row
+(tp), top-1 MoE (ep), GPipe microbatching (pp)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from znicz_tpu.ops import attention as att_ops
+from znicz_tpu.parallel.mesh import make_mesh
+from znicz_tpu.parallel.moe import moe_ffn
+from znicz_tpu.parallel.pipeline import pipeline_apply
+from znicz_tpu.parallel.ring_attention import ring_attention
+from znicz_tpu.parallel import tp
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(cpu_devices, causal):
+    mesh = make_mesh({"seq": 4})
+    rng = np.random.default_rng(0)
+    b, t, h, dh = 2, 32, 4, 16
+    q, k, v = (rng.normal(size=(b, t, h, dh)).astype(np.float32)
+               for _ in range(3))
+    dense = att_ops.attention(np, q, k, v, causal=causal)
+
+    ring = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "seq", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"))
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-4, atol=2e-5)
+
+
+def test_mha_numpy_vs_jnp():
+    rng = np.random.default_rng(1)
+    b, t, d, heads = 2, 8, 32, 4
+    x = rng.normal(size=(b, t, d)).astype(np.float32)
+    params = {f"w{n}": rng.normal(0, 0.1, (d, d)).astype(np.float32)
+              for n in "qkvo"}
+    params.update({f"b{n}": rng.normal(0, 0.1, (d,)).astype(np.float32)
+                   for n in "qkvo"})
+    y_np = att_ops.mha_forward(np, x, params, heads, causal=True)
+    y_x = att_ops.mha_forward(jnp, jnp.asarray(x),
+                              {k: jnp.asarray(v) for k, v in params.items()},
+                              heads, causal=True)
+    np.testing.assert_allclose(np.asarray(y_x), y_np, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_mha_matches_dense_mha(cpu_devices):
+    """The unit-level ring MHA wrapper equals the dense MHA op."""
+    from znicz_tpu.parallel.ring_attention import ring_mha_forward
+    mesh = make_mesh({"seq": 4})
+    rng = np.random.default_rng(7)
+    b, t, d, heads = 2, 16, 32, 4
+    x = rng.normal(size=(b, t, d)).astype(np.float32)
+    params = {f"w{n}": rng.normal(0, 0.1, (d, d)).astype(np.float32)
+              for n in "qkvo"}
+    dense = att_ops.mha_forward(np, x, params, heads, causal=True)
+    f = shard_map(
+        lambda x_, p_: ring_mha_forward(x_, p_, heads, "seq", causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"), P()), out_specs=P(None, "seq"))
+    out = jax.jit(f)(x, params)
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-4, atol=2e-5)
+
+
+def test_tensor_parallel_mlp_matches_dense(cpu_devices):
+    mesh = make_mesh({"model": 4})
+    rng = np.random.default_rng(2)
+    n, d, ff = 8, 16, 32
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w1 = rng.normal(0, 0.1, (d, ff)).astype(np.float32)
+    b1 = rng.normal(0, 0.1, (ff,)).astype(np.float32)
+    w2 = rng.normal(0, 0.1, (ff, d)).astype(np.float32)
+    b2 = rng.normal(0, 0.1, (d,)).astype(np.float32)
+    dense = np.maximum(x @ w1 + b1, 0.0) @ w2 + b2
+
+    f = shard_map(
+        lambda x_, w1_, b1_, w2_, b2_: tp.mlp(
+            x_, w1_, b1_, w2_, b2_, lambda a: jnp.maximum(a, 0.0), "model"),
+        mesh=mesh,
+        in_specs=(P(), P(None, "model"), P("model"), P("model", None), P()),
+        out_specs=P())
+    out = jax.jit(f)(x, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_expert_parallel_top1(cpu_devices):
+    mesh = make_mesh({"expert": 4})
+    rng = np.random.default_rng(3)
+    tokens, d, ff, E = 16, 8, 16, 8      # 2 experts per device
+    x = rng.normal(size=(tokens, d)).astype(np.float32)
+    gate_w = rng.normal(0, 1.0, (d, E)).astype(np.float32)
+    w1 = rng.normal(0, 0.1, (E, d, ff)).astype(np.float32)
+    b1 = rng.normal(0, 0.1, (E, ff)).astype(np.float32)
+    w2 = rng.normal(0, 0.1, (E, ff, d)).astype(np.float32)
+    b2 = rng.normal(0, 0.1, (E, d)).astype(np.float32)
+
+    # dense single-device oracle
+    scores = x @ gate_w
+    probs = np.exp(scores - scores.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    choice = scores.argmax(1)
+    oracle = np.zeros_like(x)
+    for t in range(tokens):
+        e = choice[t]
+        h = np.maximum(x[t] @ w1[e] + b1[e], 0.0)
+        oracle[t] = (h @ w2[e] + b2[e]) * probs[t, e]
+
+    f = shard_map(
+        lambda x_, g_, w1_, b1_, w2_, b2_: moe_ffn(
+            x_, g_, w1_, b1_, w2_, b2_,
+            lambda a: jnp.maximum(a, 0.0), "expert")[0],
+        mesh=mesh,
+        in_specs=(P(), P(), P("expert"), P("expert"), P("expert"),
+                  P("expert")),
+        out_specs=P())
+    out = jax.jit(f)(x, gate_w, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(out), oracle, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_matches_sequential(cpu_devices):
+    mesh = make_mesh({"pipe": 4})
+    rng = np.random.default_rng(4)
+    n_micro, mb, d = 6, 4, 8
+    xs = rng.normal(size=(n_micro, mb, d)).astype(np.float32)
+    # 4 stages of tanh(x @ W_s + b_s), stacked on the leading axis
+    ws = rng.normal(0, 0.5, (4, d, d)).astype(np.float32)
+    bs = rng.normal(0, 0.1, (4, d)).astype(np.float32)
+
+    seq = xs.copy()
+    for s in range(4):
+        seq = np.tanh(seq @ ws[s] + bs[s])
+
+    def stage_fn(params, x):
+        w, b = params
+        return jnp.tanh(x @ w[0] + b[0])
+
+    f = shard_map(
+        lambda xs_, w_, b_: pipeline_apply(stage_fn, (w_, b_), xs_, 4,
+                                           "pipe"),
+        mesh=mesh,
+        in_specs=(P(), P("pipe"), P("pipe")),
+        out_specs=P())
+    out = jax.jit(f)(xs, ws, bs)
+    np.testing.assert_allclose(np.asarray(out), seq, rtol=2e-4, atol=2e-5)

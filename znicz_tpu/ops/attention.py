@@ -1,0 +1,73 @@
+"""Multi-head attention ops (TPU-native extension; no reference
+counterpart — veles.znicz predates transformers, SURVEY.md §6.7 — but the
+rebuild treats long-context as first-class).
+
+Dense reference implementation here; the sequence-parallel ring variant
+(identical math, K/V blocks rotated over the ``seq`` mesh axis) lives in
+znicz_tpu.parallel.ring_attention and is pinned equal to this one by
+tests/test_parallel_axes.py.
+
+Layouts: activations ``(batch, time, d_model)``; heads split last dim.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def split_heads(xp, x, n_heads: int):
+    b, t, d = x.shape
+    return x.reshape(b, t, n_heads, d // n_heads)
+
+
+def merge_heads(xp, x):
+    b, t, h, dh = x.shape
+    return x.reshape(b, t, h * dh)
+
+
+def softmax(xp, x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = xp.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def masked_scores(xp, q, k, causal: bool, q_offset=0, k_offset=0):
+    """Scaled q·kᵀ scores ``(b, h, tq, tk)`` with optional causal masking;
+    ``*_offset`` give global positions when q/k are sequence blocks — the
+    ONE definition of the mask convention, shared by dense attention and
+    the ring variant (znicz_tpu.parallel.ring_attention)."""
+    dh = q.shape[-1]
+    s = xp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(dh).astype(q.dtype)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = xp.arange(tq)[:, None] + q_offset
+        kpos = xp.arange(tk)[None, :] + k_offset
+        s = xp.where((kpos > qpos)[None, None, :, :],
+                     xp.asarray(-1e30, dtype=s.dtype), s)
+    return s
+
+
+def attention(xp, q, k, v, causal: bool = False):
+    """Scaled-dot-product attention over per-head tensors
+    ``(b, t, h, dh)``."""
+    p = softmax(xp, masked_scores(xp, q, k, causal))
+    return xp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def mha_forward(xp, x, params: dict, n_heads: int, causal: bool = False):
+    """Full MHA block: qkv projections -> attention -> output projection.
+    ``params``: wq/wk/wv/wo ``(d, d)`` (+ optional bq/bk/bv/bo)."""
+    def proj(w_key, b_key):
+        y = x @ params[w_key]
+        if params.get(b_key) is not None:
+            y = y + params[b_key]
+        return split_heads(xp, y, n_heads)
+
+    q = proj("wq", "bq")
+    k = proj("wk", "bk")
+    v = proj("wv", "bv")
+    o = merge_heads(xp, attention(xp, q, k, v, causal=causal))
+    y = o @ params["wo"]
+    if params.get("bo") is not None:
+        y = y + params["bo"]
+    return y

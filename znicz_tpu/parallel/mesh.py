@@ -20,7 +20,16 @@ from typing import Optional, Sequence
 import numpy as np
 
 import jax
+from jax import lax
 from jax.sharding import Mesh
+
+
+def varying(x, axis_name):
+    """Mark ``x`` as varying over ``axis_name`` (shard_map vma typing for
+    scan carries); pcast on current jax, pvary fallback on older."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
 
 
 def make_mesh(axis_sizes: dict[str, int],

@@ -1,0 +1,41 @@
+"""Expert-parallel mixture-of-experts FFN over the ``expert`` mesh axis
+(TPU-native extension; the reference has no MoE — SURVEY.md §3.4 EP row).
+
+v1 semantics: top-1 gating with dense masked compute — each device runs
+its *local* experts over all tokens, masks by the gate's one-hot choice,
+and a single ``psum`` over the expert axis combines the winners.  This is
+exact top-1 MoE (identical to dispatch-based routing) at the cost of
+E_local x compute per token; an all_to_all token-dispatch path is the
+planned optimization and slots behind the same function signature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(x, gate_w, w1_local, b1_local, w2_local, b2_local,
+            act, axis_name: str = "expert"):
+    """x ``(tokens, d)`` replicated over the expert axis; ``gate_w``
+    ``(d, n_experts_total)`` replicated; ``w1_local`` ``(e_local, d, ff)``,
+    ``w2_local`` ``(e_local, ff, d)`` expert-sharded.  Returns replicated
+    ``(tokens, d)`` plus the (replicated) gate distribution for load-
+    balancing diagnostics."""
+    ep = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    e_local = w1_local.shape[0]
+    scores = x @ gate_w                          # (tokens, E)
+    gate_probs = jax.nn.softmax(scores, axis=-1)
+    choice = scores.argmax(axis=-1)              # (tokens,)
+    # local expert ids: my_idx*e_local .. +e_local
+    local_ids = my_idx * e_local + jnp.arange(e_local)
+    # (e_local, tokens) one-hot of "token routed to this local expert"
+    sel = (choice[None, :] == local_ids[:, None]).astype(x.dtype)
+    gate_val = jnp.take_along_axis(gate_probs, choice[:, None],
+                                   axis=1)[:, 0]  # (tokens,)
+    h = act(jnp.einsum("td,edf->etf", x, w1_local) + b1_local[:, None, :])
+    y_e = jnp.einsum("etf,efd->etd", h, w2_local) + b2_local[:, None, :]
+    y_local = (y_e * sel[:, :, None]).sum(axis=0) * gate_val[:, None]
+    return lax.psum(y_local, axis_name), gate_probs

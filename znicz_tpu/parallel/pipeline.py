@@ -1,0 +1,59 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis — GPipe-style
+microbatch rotation expressed as one SPMD program (TPU-native extension;
+SURVEY.md §3.4 PP row).
+
+All devices run the same traced loop; device ``s`` applies stage ``s``'s
+params (stacked stage weights sharded over the pipe axis, leading dim).
+Each tick every device hands its activation to the next stage via one
+``lax.ppermute`` (neighbor ICI traffic); stage 0 feeds microbatch ``t``,
+stage ``S-1`` collects finished microbatch ``t - (S-1)``.  The bubble is
+the standard ``S-1`` ticks.
+
+Exactness pin: tests/test_parallel_axes.py::test_pipeline_matches_sequential.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params_local, xs, n_stages: int,
+                   axis_name: str = "pipe"):
+    """Run ``n_micro`` microbatches through the stage pipeline.
+
+    - ``stage_fn(params, x) -> y``: one stage's compute; every stage must
+      map shape ``(mb, d) -> (mb, d)`` (homogeneous-stage pipeline);
+    - ``stage_params_local``: this device's stage params pytree (the
+      caller shards a stage-stacked pytree over the pipe axis);
+    - ``xs``: ``(n_micro, mb, d)`` microbatches (replicated);
+    - ``n_stages``: static pipe-axis size (mesh.shape[axis_name]).
+    Returns ``(n_micro, mb, d)``, replicated via the final psum.
+    """
+    stage = lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(act, t):
+        # stage 0 ingests microbatch t (clipped; ticks past the feed window
+        # only drain the pipe)
+        feed = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+        act = jnp.where(stage == 0, feed, act)
+        y = stage_fn(stage_params_local, act)
+        # the last stage emits the finished microbatch; others emit zeros
+        done = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
+        # rotate activations one stage forward (wraparound into stage 0 is
+        # overwritten by the next feed)
+        return lax.ppermute(y, axis_name, perm_fwd), done
+
+    from znicz_tpu.parallel.mesh import varying
+    # initial carry inherits xs's varying axes (e.g. data) and is cast
+    # varying over the pipe axis the loop rotates on (scan vma rule)
+    act0 = varying(xs[0] * 0.0, axis_name)
+    _, emitted = lax.scan(tick, act0,
+                          jnp.arange(n_micro + n_stages - 1))
+    # microbatch t finishes at tick t + (S-1); gather in feed order, then
+    # replicate off the last stage
+    outs = emitted[jnp.arange(n_micro) + (n_stages - 1)]
+    return lax.psum(outs, axis_name)

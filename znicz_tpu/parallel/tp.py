@@ -1,0 +1,41 @@
+"""Tensor-parallel linear layers over the ``model`` mesh axis
+(SURVEY.md §3.4 "natural extension via jax.sharding on weight dims" —
+Megatron column/row pattern expressed for shard_map).
+
+- ``column_parallel``: W sharded on the output dim; each device computes
+  its slice of the features.  No communication (the activation stays
+  feature-sharded).
+- ``row_parallel``: W sharded on the input dim, activation feature-sharded
+  from the previous column layer; partial products are ``psum``ed back to
+  replicated.  One ICI all-reduce per layer pair — the Megatron MLP shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel(x, w_local, b_local=None):
+    """x replicated ``(..., d_in)``; w_local ``(d_in, d_out/tp)`` ->
+    feature-sharded ``(..., d_out/tp)``."""
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel(x_local, w_local, b=None, axis_name: str = "model"):
+    """x_local feature-sharded ``(..., d_in/tp)``; w_local
+    ``(d_in/tp, d_out)`` -> replicated ``(..., d_out)`` via one psum.
+    ``b`` must be replicated (added once, after the reduce)."""
+    y = lax.psum(x_local @ w_local, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def mlp(x, w1_local, b1_local, w2_local, b2, act, axis_name: str = "model"):
+    """Megatron MLP: column-parallel + activation + row-parallel."""
+    h = act(column_parallel(x, w1_local, b1_local))
+    return row_parallel(h, w2_local, b2, axis_name)

@@ -1,0 +1,198 @@
+"""Long-context sharded transformer — the flagship multi-axis SPMD model
+(TPU-native extension; the task treats long-context + distributed as
+first-class even though the reference predates transformers, SURVEY.md
+§6.7).
+
+One ``shard_map``-ped training step over a ``(data, seq, model)`` mesh:
+
+- batch sharded over ``data`` (DP) — gradients reduce via the loss psum;
+- sequence sharded over ``seq`` (SP) — exact ring attention rotates K/V
+  blocks over ICI (znicz_tpu.parallel.ring_attention);
+- attention heads + MLP hidden sharded over ``model`` (TP) — Megatron
+  column/row pattern, one psum per block half (znicz_tpu.parallel.tp).
+
+``make_pipeline_step`` provides the complementary ``(data, pipe, expert)``
+configuration: GPipe microbatching over ``pipe`` with expert-parallel MoE
+blocks over ``expert`` (znicz_tpu.parallel.{pipeline,moe}).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from znicz_tpu.parallel.moe import moe_ffn
+from znicz_tpu.parallel.pipeline import pipeline_apply
+from znicz_tpu.parallel.ring_attention import ring_attention
+from znicz_tpu.parallel import tp
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
+# -- dp x sp x tp flagship --------------------------------------------------
+def init_params(gen, n_layers: int, d: int, heads: int, ff: int,
+                vocab: int):
+    """Global (unsharded) parameter pytree from the framework PRNG."""
+    def w(shape, scale=None):
+        scale = scale or 1.0 / np.sqrt(shape[0])
+        return gen.normal(0.0, scale, shape).astype(np.float32)
+
+    blocks = []
+    for _ in range(n_layers):
+        blocks.append({
+            "ln1_g": np.ones(d, np.float32), "ln1_b": np.zeros(d, np.float32),
+            "wq": w((d, d)), "wk": w((d, d)), "wv": w((d, d)), "wo": w((d, d)),
+            "ln2_g": np.ones(d, np.float32), "ln2_b": np.zeros(d, np.float32),
+            "w1": w((d, ff)), "b1": np.zeros(ff, np.float32),
+            "w2": w((ff, d)), "b2": np.zeros(d, np.float32),
+        })
+    return {"emb": w((vocab, d), 0.02), "head": w((d, vocab)),
+            "blocks": blocks}
+
+
+def param_specs(n_layers: int):
+    """PartitionSpecs matching init_params: attention qkv column-sharded,
+    wo row-sharded, MLP Megatron-sharded over ``model``; the rest
+    replicated."""
+    blk = {
+        "ln1_g": P(), "ln1_b": P(),
+        "wq": P(None, "model"), "wk": P(None, "model"),
+        "wv": P(None, "model"), "wo": P("model", None),
+        "ln2_g": P(), "ln2_b": P(),
+        "w1": P(None, "model"), "b1": P("model"),
+        "w2": P("model", None), "b2": P(),
+    }
+    return {"emb": P(), "head": P(), "blocks": [dict(blk)] * n_layers}
+
+
+def _block(x, p, heads_local: int, causal: bool):
+    """One transformer block on local shards: ring attention (seq axis)
+    with tp-sharded heads, then Megatron MLP (model axis)."""
+    h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+    b, t_loc, _ = h.shape
+
+    def heads_of(w):
+        y = h @ w                                    # (b, t_loc, d_local)
+        return y.reshape(b, t_loc, heads_local, -1)
+
+    q, k, v = heads_of(p["wq"]), heads_of(p["wk"]), heads_of(p["wv"])
+    o = ring_attention(q, k, v, "seq", causal=causal)
+    o = o.reshape(b, t_loc, -1)                      # (b, t_loc, d_local)
+    x = x + tp.row_parallel(o, p["wo"], None, "model")
+    m = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+    x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
+                   jax.nn.gelu, "model")
+    return x
+
+
+def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
+                    vocab: int, lr: float = 0.1, causal: bool = True):
+    """-> jitted ``step(params, tokens, labels) -> (params, loss)``.
+
+    ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
+    ``data`` and time over ``seq``; per-position class targets (CE loss).
+    """
+    tp_size = mesh.shape["model"]
+    if heads % tp_size or d % tp_size or ff % tp_size:
+        raise ValueError(f"tp={tp_size} must divide heads={heads}, "
+                         f"d={d} and ff={ff}")
+    heads_local = heads // tp_size
+    specs = param_specs(n_layers)
+
+    def local_step(params, tokens, labels):
+        def loss_fn(ps):
+            x = ps["emb"][tokens]                     # (b_l, t_l, d)
+            for p in ps["blocks"]:
+                x = _block(x, p, heads_local, causal)
+            logits = x @ ps["head"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logp, labels[..., None], axis=-1)[..., 0]
+            # psum makes AD emit globally-reduced grads for replicated
+            # params; model-sharded params get their local shard's grad
+            return lax.psum(-picked.mean(), ("data", "seq"))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
+        new_params = jax.tree.map(
+            lambda w, g: w - lr * g / n_shards, params, grads)
+        return new_params, loss / n_shards
+
+    step = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P("data", "seq"), P("data", "seq")),
+        out_specs=(specs, P()))
+    return jax.jit(step), specs
+
+
+# -- dp x pipe x expert configuration ---------------------------------------
+def init_moe_pipeline_params(gen, n_stages: int, d: int, ff: int,
+                             n_experts: int):
+    """Stage-stacked MoE-block params (leading dim = pipe stage)."""
+    def w(shape, scale=None):
+        scale = scale or 1.0 / np.sqrt(shape[-2])
+        return gen.normal(0.0, scale, shape).astype(np.float32)
+
+    return {
+        "gate": w((n_stages, d, n_experts)),
+        "w1": w((n_stages, n_experts, d, ff)),
+        "b1": np.zeros((n_stages, n_experts, ff), np.float32),
+        "w2": w((n_stages, n_experts, ff, d)),
+        "b2": np.zeros((n_stages, n_experts, d), np.float32),
+    }
+
+
+def moe_pipeline_specs():
+    return {k: P("pipe", "expert") if k != "gate" else P("pipe")
+            for k in ("gate", "w1", "b1", "w2", "b2")}
+
+
+def make_pipeline_step(mesh: Mesh, n_experts: int, lr: float = 0.05):
+    """-> jitted ``step(params, xs, ys) -> (params, loss)`` on a
+    ``(data, pipe, expert)`` mesh: each pipe stage is an expert-parallel
+    MoE residual block; xs ``(n_micro, mb, d)`` microbatches (data-sharded
+    on mb), ys same shape (regression targets — keeps the demo loss
+    self-contained).  Feature/ff sizes flow from the params pytree."""
+    n_stages = mesh.shape["pipe"]
+    ep = mesh.shape["expert"]
+    if n_experts % ep:
+        raise ValueError(f"expert-axis size {ep} must divide "
+                         f"n_experts={n_experts}")
+    specs = moe_pipeline_specs()
+
+    def stage_fn(p, x):
+        y, _ = moe_ffn(x, p["gate"][0], p["w1"][0], p["b1"][0],
+                       p["w2"][0], p["b2"][0], jax.nn.gelu, "expert")
+        return x + y
+
+    def local_step(params, xs, ys):
+        def loss_fn(ps):
+            out = pipeline_apply(lambda _unused, x: stage_fn(ps, x), None,
+                                 xs, n_stages, "pipe")
+            diff = out - ys
+            return lax.psum((diff * diff).mean(), "data")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        n_data = lax.psum(1, "data")
+        new_params = jax.tree.map(
+            lambda w, g: w - lr * g / n_data, params, grads)
+        return new_params, loss / n_data
+
+    step = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P(None, "data"), P(None, "data")),
+        out_specs=(specs, P()))
+    return jax.jit(step), specs
