@@ -1,0 +1,124 @@
+"""CLI / Launcher / genetics / ensemble tests (SURVEY.md §3.3: Main,
+Launcher, genetics, ensemble rows)."""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from znicz_tpu.__main__ import main as cli_main
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import TPUDevice
+from znicz_tpu.core.config import Tune, root, set_by_path
+from znicz_tpu.launcher import Launcher
+from znicz_tpu.models import wine
+from znicz_tpu.utils.ensemble import Ensemble
+from znicz_tpu.utils.genetics import Genetics
+
+
+WINE_WORKFLOW = textwrap.dedent("""
+    import json
+    from znicz_tpu.core.config import root
+    from znicz_tpu.models import wine
+
+    def run(load, main):
+        epochs = root.wine.get("max_epochs", 3)
+        w, _ = load(wine.build, max_epochs=epochs, n_train=60, n_valid=30,
+                    minibatch_size=10)
+        main()
+        out = root.wine.get("result_file", None)
+        if out:
+            with open(out, "w") as f:
+                json.dump({"epochs": len(w.decision.metrics_history),
+                           "best": w.decision.best_metric}, f)
+    """)
+
+
+def test_launcher_load_main_contract():
+    prng.seed_all(3)
+    launcher = Launcher(device=TPUDevice())
+    wine.run(lambda b, **kw: launcher.load(b, max_epochs=3, n_train=60,
+                                           n_valid=30, minibatch_size=10,
+                                           **kw),
+             launcher.main)
+    assert bool(launcher.workflow.decision.complete)
+    assert len(launcher.workflow.decision.metrics_history) == 3
+
+
+def test_launcher_snapshot_resume(tmp_path):
+    prng.seed_all(3)
+    w = wine.build(max_epochs=4, n_train=60, n_valid=30, minibatch_size=10,
+                   snapshotter_config={"directory": str(tmp_path),
+                                       "prefix": "w", "only_improved": False,
+                                       "keep_all": True})
+    w.initialize(device=TPUDevice())
+    w.run()
+    snap = tmp_path / "w_2.npz"
+    assert snap.exists()
+
+    prng.seed_all(3)
+    launcher = Launcher(device=TPUDevice(), snapshot=str(snap))
+    launcher.load(wine.build, max_epochs=4, n_train=60, n_valid=30,
+                  minibatch_size=10)
+    launcher.main()
+    assert launcher.workflow.decision.metrics_history == \
+        w.decision.metrics_history
+
+
+def test_cli_end_to_end(tmp_path):
+    wf = tmp_path / "wine_wf.py"
+    wf.write_text(WINE_WORKFLOW)
+    cfg = tmp_path / "wine_config.py"
+    cfg.write_text("root.wine.max_epochs = 2\n")
+    result_file = tmp_path / "result.json"
+    rc = cli_main([str(wf), str(cfg), "--random-seed", "5", "-d", "tpu",
+                   "-o", f"root.wine.result_file={result_file}"])
+    assert rc == 0
+    result = json.loads(result_file.read_text())
+    assert result["epochs"] == 2
+    assert result["best"] is not None
+    del root.wine
+
+
+def test_cli_optimize(tmp_path):
+    wf = tmp_path / "wine_opt.py"
+    wf.write_text(textwrap.dedent("""
+        from znicz_tpu.core.config import root
+        from znicz_tpu.models import wine
+
+        def run(load, main):
+            load(wine.build, max_epochs=2, n_train=60, n_valid=30,
+                 minibatch_size=10, lr=float(root.wine_opt.lr))
+            main()
+        """))
+    set_by_path(root, "wine_opt.lr", Tune(0.3, 0.01, 1.0))
+    rc = cli_main([str(wf), "--optimize", "2", "-d", "tpu"])
+    assert rc == 0
+    del root.wine_opt
+
+
+def test_genetics_pure_function():
+    tunes = {"x": Tune(0.0, -10.0, 10.0), "y": Tune(0.0, -5.0, 5.0)}
+    prng.seed_all(4)
+    ga = Genetics(lambda ind: (ind["x"] - 3.0) ** 2 + ind["y"] ** 2,
+                  tunes=tunes, population_size=12, mutation_rate=0.5)
+    best, fit = ga.run(generations=8)
+    assert fit < 1.0, (best, fit)
+    assert abs(best["x"] - 3.0) < 1.5
+
+
+def test_ensemble_committee(tmp_path):
+    ens = Ensemble(wine.build, n_members=3, base_seed=50, max_epochs=3,
+                   n_train=60, n_valid=30, minibatch_size=10)
+    ens.train(TPUDevice())
+    report = ens.test_classification()
+    assert report["n"] == 30
+    # the committee must not be worse than the worst member
+    assert report["committee_err"] <= max(report["member_errs"])
+    # predictions shapes
+    loader = ens.members[0].loader
+    data = loader.original_data.map_read()[:8]
+    assert ens.predict_classes(data).shape == (8,)
+    assert ens.predict_mean(data).shape[0] == 8

@@ -1,0 +1,152 @@
+"""Service-layer tests: plotters, ImageSaver, web status, forward export +
+forge (SURVEY.md §3.3 Graphics/Web/Forge rows, §4.5 inference path)."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import TPUDevice
+from znicz_tpu.core.memory import Array
+from znicz_tpu.models import kohonen as kohonen_model, wine
+from znicz_tpu.plotting import (AccumulatingPlotter, Histogram, ImagePlotter,
+                                MatrixPlotter)
+from znicz_tpu.units.image_saver import ImageSaver
+from znicz_tpu.units.nn_plotting import (KohonenHits, KohonenInputMaps,
+                                         KohonenNeighborMap, MultiHistogram,
+                                         Weights2D, tile_filters)
+from znicz_tpu.utils.export import (ExportedForward, export_forward,
+                                    forge_fetch, forge_list, forge_publish)
+from znicz_tpu.web_status import WebStatus
+
+
+def _trained_wine(seed=3, **kw):
+    prng.seed_all(seed)
+    w = wine.build(max_epochs=3, n_train=60, n_valid=30, minibatch_size=10,
+                   **kw)
+    w.initialize(device=TPUDevice())
+    w.run()
+    w.stop()
+    return w
+
+
+def test_plotters_render_files(tmp_path):
+    w = _trained_wine()
+    acc = AccumulatingPlotter(None, name="err_curve",
+                              directory=str(tmp_path))
+    for v in (5.0, 3.0, 1.0):
+        acc.input = v
+        acc.run()
+    assert acc.render_count == 3 and os.path.exists(acc.last_path)
+
+    mat = MatrixPlotter(None, name="confusion", directory=str(tmp_path))
+    mat.input = np.array([[5, 1], [0, 7]])
+    mat.run()
+    assert os.path.exists(mat.last_path)
+
+    img = ImagePlotter(None, name="sample", directory=str(tmp_path))
+    img.input = np.zeros((8, 8, 1), np.float32)
+    img.run()
+    hist = Histogram(None, name="whist", directory=str(tmp_path))
+    hist.input = w.forwards[0].weights
+    hist.run()
+    w2d = Weights2D(None, name="w2d", directory=str(tmp_path),
+                    sample_shape=(13, 1))
+    w2d.input = w.forwards[0].weights
+    w2d.run()
+    mh = MultiHistogram(None, name="mh", directory=str(tmp_path))
+    mh.inputs = [f.weights for f in w.forwards]
+    mh.run()
+    assert len(os.listdir(tmp_path)) == 6
+
+
+def test_tile_filters_shapes():
+    grid = tile_filters(np.random.default_rng(0).normal(size=(16, 9))
+                        .astype(np.float32))
+    assert grid.shape == (3 * 5 - 1, 3 * 5 - 1)
+    conv_grid = tile_filters(np.random.default_rng(0)
+                             .normal(size=(3, 3, 2, 4)).astype(np.float32))
+    assert conv_grid.shape == (2 * 4 - 1, 2 * 4 - 1)
+
+
+def test_kohonen_plotters(tmp_path):
+    prng.seed_all(23)
+    w = kohonen_model.build(max_epochs=2, shape=(4, 4), n_train=200)
+    w.initialize(device=TPUDevice())
+    w.run()
+    w.forward.batch_size = 50
+    w.forward.input = w.loader.minibatch_data
+    w.forward.run()
+    for cls, attr in ((KohonenHits, "forward"), (KohonenInputMaps, "trainer"),
+                      (KohonenNeighborMap, "trainer")):
+        p = cls(None, name=cls.__name__, directory=str(tmp_path))
+        setattr(p, attr, getattr(w, attr))
+        p.run()
+        assert os.path.exists(p.last_path)
+
+
+def test_image_saver(tmp_path):
+    prng.seed_all(9)
+    saver = ImageSaver(None, directory=str(tmp_path), limit=4)
+    rng = np.random.default_rng(0)
+    saver.input = Array(rng.normal(size=(10, 6, 6, 1)).astype(np.float32))
+    probs = np.full((10, 3), 0.2, np.float32)
+    probs[:, 0] = 0.6                      # predict class 0 for everyone
+    saver.output = Array(probs)
+    saver.labels = Array(np.arange(10, dtype=np.int32) % 3)
+    saver.minibatch_size = 10
+    saver.minibatch_class = 2
+    saver.epoch_number = 1
+    saver.run()
+    saver.flush()
+    assert 0 < len(saver.saved_paths) <= 4
+    for p in saver.saved_paths:
+        assert os.path.exists(p)
+
+
+def test_web_status_endpoint():
+    w = _trained_wine()
+    ws = WebStatus(port=0).register(w)
+    port = ws.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status.json", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["workflows"][0]["name"] == "Wine"
+        assert payload["workflows"][0]["complete"] is True
+        assert len(payload["workflows"][0]["history"]) == 3
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5) as r:
+            assert b"Wine" in r.read()
+    finally:
+        ws.stop()
+
+
+def test_export_and_forge_roundtrip(tmp_path):
+    w = _trained_wine()
+    pkg = str(tmp_path / "wine.npz")
+    export_forward(w, pkg)
+    model = ExportedForward(pkg)
+    loader = w.loader
+    data = loader.original_data.map_read()[:12]
+    probs = model(data)
+    assert probs.shape == (12, 3)
+    # exported forward == the workflow's own eval forward (the fused chain
+    # returns pre-softmax logits when the loss composes log_softmax)
+    import jax
+    w.step.sync_to_units()
+    ref, logits_tail = w.step._forward_chain(w.step._params, data,
+                                             train=False)
+    assert logits_tail
+    np.testing.assert_allclose(probs, np.asarray(jax.nn.softmax(ref, axis=1)),
+                               rtol=1e-5, atol=1e-6)
+
+    repo = str(tmp_path / "forge")
+    forge_publish(pkg, repo, "wine", "1.0",
+                  metrics={"best": w.decision.best_metric})
+    forge_publish(pkg, repo, "wine", "1.1")
+    assert forge_list(repo) == {"wine": ["1.0", "1.1"]}
+    fetched = forge_fetch(repo, "wine")          # latest
+    np.testing.assert_allclose(fetched(data), probs, rtol=1e-6)

@@ -1,0 +1,101 @@
+"""CLI entry — rebuild of veles/__main__.py :: Main (the ``veles
+<workflow.py> <config.py> [flags]`` console command).
+
+Usage:
+    python -m znicz_tpu <workflow.py> [config.py ...] [options]
+
+The workflow file must expose ``run(load, main)`` (every models/ sample
+does); config files are executed Python mutating the global ``root`` tree;
+``-o root.path=value`` applies last.  ``--optimize N`` wraps the run in
+the genetic hyperparameter search over ``Tune`` leaves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib.util
+import sys
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import AutoDevice, NumpyDevice, TPUDevice
+from znicz_tpu.core.config import (apply_config_file, root, set_by_path)
+from znicz_tpu.launcher import Launcher, multihost
+
+
+def load_workflow_module(path: str):
+    spec = importlib.util.spec_from_file_location("znicz_workflow", path)
+    if spec is None:
+        raise SystemExit(f"cannot import workflow file {path!r}")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    if not hasattr(module, "run"):
+        raise SystemExit(f"{path!r} does not expose run(load, main)")
+    return module
+
+
+def _parse_value(text: str):
+    try:
+        return ast.literal_eval(text)
+    except (ValueError, SyntaxError):
+        return text
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu",
+        description="TPU-native VELES/Znicz: run a workflow file")
+    p.add_argument("workflow", help="workflow .py exposing run(load, main)")
+    p.add_argument("configs", nargs="*", help="config .py files (executed "
+                   "in order, mutating the global root tree)")
+    p.add_argument("-d", "--device", choices=("auto", "tpu", "numpy"),
+                   default="auto")
+    p.add_argument("--random-seed", type=int, default=1,
+                   help="seed for all PRNG streams (reference --random-seed)")
+    p.add_argument("-w", "--snapshot", default=None,
+                   help="resume from a .npz snapshot (reference -w)")
+    p.add_argument("-s", "--stealth", action="store_true",
+                   help="suppress plotters/side services (reference -s)")
+    p.add_argument("-o", "--override", action="append", default=[],
+                   metavar="root.path=value",
+                   help="config override, applied after config files")
+    p.add_argument("--optimize", type=int, default=None, metavar="GENS",
+                   help="genetic hyperparameter search over Tune() leaves")
+    # multi-host SPMD (replaces the reference's -l/-m master/slave flags)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (multi-host SPMD)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p
+
+
+def make_device(name: str):
+    return {"auto": AutoDevice, "tpu": TPUDevice,
+            "numpy": NumpyDevice}[name]()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.coordinator is not None:
+        multihost(args.coordinator, args.num_processes, args.process_id)
+    prng.seed_all(args.random_seed)
+    for cfg in args.configs:
+        apply_config_file(cfg)
+    for override in args.override:
+        path, _, value = override.partition("=")
+        path = path.removeprefix("root.")
+        set_by_path(root, path, _parse_value(value))
+    module = load_workflow_module(args.workflow)
+    launcher = Launcher(device=make_device(args.device),
+                        snapshot=args.snapshot, stealth=args.stealth)
+    if args.optimize is not None:
+        from znicz_tpu.utils.genetics import optimize
+        best = optimize(module, launcher, generations=args.optimize)
+        print(f"best config: {best}")
+        return 0
+    module.run(launcher.load, launcher.main)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

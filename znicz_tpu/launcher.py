@@ -1,0 +1,82 @@
+"""Launcher — rebuild of veles/launcher.py :: Launcher.
+
+Owns a workflow's lifecycle: device selection, optional snapshot resume,
+initialize/run/stop, timing-table report.  The reference's
+standalone/master/slave trichotomy collapses to SPMD (SURVEY.md §3.4): a
+multi-host run is N identical processes that call
+``jax.distributed.initialize`` (``multihost()``) and then run the same
+standalone code path — XLA's collectives over ICI/DCN replace the ZeroMQ
+job protocol, so there is no separate Server/Client pair to manage.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional
+
+from znicz_tpu.core.backends import AutoDevice, Device
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.snapshotter import restore_state
+
+
+def multihost(coordinator: str, num_processes: int, process_id: int) -> None:
+    """Join a multi-host SPMD job (reference: the -l/-m master/slave flags;
+    here every process is a peer).  Call before any jax device use."""
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+class Launcher(Logger):
+    """Boot/own one workflow run (reference: veles/launcher.py)."""
+
+    def __init__(self, device: Optional[Device] = None,
+                 snapshot: Optional[str] = None,
+                 stealth: bool = False) -> None:
+        super().__init__()
+        self.device = device
+        self.snapshot = snapshot
+        #: stealth: suppress side services (plotters/web) — reference -s
+        self.stealth = stealth
+        self.workflow = None
+        self._interrupted = False
+
+    # -- the load/main pair handed to sample modules ------------------------
+    def load(self, builder, **kwargs):
+        """Reference ``load`` contract: build the workflow (module-supplied
+        builder + kwargs), remember it, return (workflow, from_snapshot)."""
+        self.workflow = builder(**kwargs)
+        return self.workflow, self.snapshot is not None
+
+    def main(self, **_ignored):
+        """Reference ``main`` contract: initialize, resume, run, stop."""
+        if self.workflow is None:
+            raise RuntimeError("load() was not called before main()")
+        device = self.device if self.device is not None else AutoDevice()
+        self.info(f"initializing {self.workflow.name} on {device!r}")
+        self.workflow.initialize(device=device)
+        if self.snapshot:
+            meta = restore_state(self.workflow, self.snapshot)
+            self.info(f"resumed from {self.snapshot} "
+                      f"(epoch {meta['loader']['epoch_number']})")
+        prev = signal.signal(signal.SIGINT, self._on_sigint)
+        try:
+            self.workflow.run()
+        finally:
+            signal.signal(signal.SIGINT, prev)
+            self.workflow.stop()
+        self.info("timing:\n" + self.workflow.timing_table())
+        return self.workflow
+
+    def _on_sigint(self, signum, frame):
+        # flip the decision's complete gate so the loop exits at the next
+        # epoch boundary check; second ^C raises immediately
+        if self._interrupted:
+            raise KeyboardInterrupt
+        self._interrupted = True
+        self.warning("SIGINT: finishing current minibatch, then stopping "
+                     "(press again to abort)")
+        if self.workflow is not None and \
+                getattr(self.workflow, "decision", None) is not None:
+            self.workflow.decision.complete.set(True)

@@ -6,20 +6,26 @@ from __future__ import annotations
 
 from znicz_tpu.standard_workflow import StandardWorkflow
 
-LAYERS = [
-    {"type": "all2all_tanh", "->": {"output_sample_shape": 10},
-     "<-": {"learning_rate": 0.3, "gradient_moment": 0.5}},
-    {"type": "softmax", "->": {"output_sample_shape": 3},
-     "<-": {"learning_rate": 0.3, "gradient_moment": 0.5}},
-]
+def layers(lr: float = 0.3, moment: float = 0.5, hidden: int = 10):
+    return [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": hidden},
+         "<-": {"learning_rate": lr, "gradient_moment": moment}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": lr, "gradient_moment": moment}},
+    ]
+
+
+LAYERS = layers()
 
 
 def build(max_epochs: int = 20, minibatch_size: int = 10,
-          n_train: int = 150, n_valid: int = 30, fused: bool = True,
+          n_train: int = 150, n_valid: int = 30, lr: float = 0.3,
+          hidden: int = 10, fused: bool = True,
           mesh=None, snapshotter_config: dict | None = None
           ) -> StandardWorkflow:
     return StandardWorkflow(
-        name="Wine", layers=LAYERS, loss_function="softmax",
+        name="Wine", layers=layers(lr=lr, hidden=hidden),
+        loss_function="softmax",
         loader_name="synthetic_classifier",
         loader_config={"n_classes": 3, "sample_shape": (13,),
                        "n_train": n_train, "n_valid": n_valid,

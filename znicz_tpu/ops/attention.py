@@ -54,9 +54,13 @@ def attention(xp, q, k, v, causal: bool = False):
     return xp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def mha_forward(xp, x, params: dict, n_heads: int, causal: bool = False):
+def mha_forward(xp, x, params: dict, n_heads: int, causal: bool = False,
+                attention_fn=None):
     """Full MHA block: qkv projections -> attention -> output projection.
-    ``params``: wq/wk/wv/wo ``(d, d)`` (+ optional bq/bk/bv/bo)."""
+    ``params``: wq/wk/wv/wo ``(d, d)`` (+ optional bq/bk/bv/bo).
+    ``attention_fn(q, k, v, causal)`` overrides the core (the ring variant
+    passes its sequence-parallel kernel) — ONE definition of the
+    projection/param convention for all MHA assemblies."""
     def proj(w_key, b_key):
         y = x @ params[w_key]
         if params.get(b_key) is not None:
@@ -66,8 +70,11 @@ def mha_forward(xp, x, params: dict, n_heads: int, causal: bool = False):
     q = proj("wq", "bq")
     k = proj("wk", "bk")
     v = proj("wv", "bv")
-    o = merge_heads(xp, attention(xp, q, k, v, causal=causal))
-    y = o @ params["wo"]
+    if attention_fn is None:
+        o = attention(xp, q, k, v, causal=causal)
+    else:
+        o = attention_fn(q, k, v, causal=causal)
+    y = merge_heads(xp, o) @ params["wo"]
     if params.get("bo") is not None:
         y = y + params["bo"]
     return y

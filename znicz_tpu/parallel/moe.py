@@ -23,7 +23,6 @@ def moe_ffn(x, gate_w, w1_local, b1_local, w2_local, b2_local,
     ``w2_local`` ``(e_local, ff, d)`` expert-sharded.  Returns replicated
     ``(tokens, d)`` plus the (replicated) gate distribution for load-
     balancing diagnostics."""
-    ep = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     e_local = w1_local.shape[0]
     scores = x @ gate_w                          # (tokens, E)

@@ -68,18 +68,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 def ring_mha_forward(x, params: dict, n_heads: int, axis_name: str,
                      causal: bool = False):
     """MHA with ring attention: x ``(b, t_local, d)`` sequence-sharded;
-    projection weights replicated (or tp-sharded by the caller)."""
-    from znicz_tpu.ops.attention import merge_heads, split_heads
+    projection weights replicated (or tp-sharded by the caller).  Same
+    projection/param convention as the dense op — only the core differs."""
+    from znicz_tpu.ops.attention import mha_forward
 
-    def proj(w_key, b_key):
-        y = x @ params[w_key]
-        if params.get(b_key) is not None:
-            y = y + params[b_key]
-        return split_heads(jnp, y, n_heads)
+    def core(q, k, v, causal):
+        return ring_attention(q, k, v, axis_name, causal=causal)
 
-    q, k, v = proj("wq", "bq"), proj("wk", "bk"), proj("wv", "bv")
-    o = merge_heads(jnp, ring_attention(q, k, v, axis_name, causal=causal))
-    y = o @ params["wo"]
-    if params.get("bo") is not None:
-        y = y + params["bo"]
-    return y
+    return mha_forward(jnp, x, params, n_heads, causal=causal,
+                       attention_fn=core)

@@ -1,0 +1,2 @@
+"""Service utilities: genetic hyperparameter search, ensembles, export
+(SURVEY.md §3.3 genetics/ensemble/forge rows)."""

@@ -1,0 +1,87 @@
+"""Model ensembles — rebuild of veles/ensemble/ (``--ensemble-train`` /
+``--ensemble-test``): train N seeded instances of a workflow, evaluate as
+a committee.
+
+Classification committees majority-vote the argmax predictions (ties break
+toward the lower class id, deterministic); regression committees average
+outputs.  The reference ran members as distributed jobs; here members run
+sequentially on the local device (concurrent pod-slice jobs are the
+multi-host upgrade path, SURVEY.md §3.4 hyperparameter-parallelism row).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.loader.base import VALID
+
+
+class Ensemble(Logger):
+    """Train + evaluate a committee of identically-built workflows."""
+
+    def __init__(self, builder: Callable, n_members: int = 5,
+                 base_seed: int = 1000, **builder_kwargs) -> None:
+        super().__init__()
+        self.builder = builder
+        self.n_members = n_members
+        self.base_seed = base_seed
+        self.builder_kwargs = builder_kwargs
+        self.members: list = []
+
+    def train(self, device) -> "Ensemble":
+        """Reference --ensemble-train: N runs with distinct seeds."""
+        for i in range(self.n_members):
+            prng.seed_all(self.base_seed + i)
+            w = self.builder(**self.builder_kwargs)
+            w.initialize(device=device)
+            w.run()
+            w.stop()
+            self.members.append(w)
+            self.info(f"member {i}: best metric "
+                      f"{w.decision.best_metric}")
+        return self
+
+    # -- committee evaluation ----------------------------------------------
+    def _member_outputs(self, w, data: np.ndarray) -> np.ndarray:
+        """Forward ``data`` through a trained member's fused params."""
+        step = w.step
+        params = step._params
+        out, _ = step._forward_chain(
+            [{k: v for k, v in leaf.items()} for leaf in params],
+            jnp.asarray(data), train=False)
+        return np.asarray(out)
+
+    def predict_classes(self, data: np.ndarray) -> np.ndarray:
+        """Majority vote over member argmaxes (reference --ensemble-test)."""
+        votes = np.stack([self._member_outputs(w, data).argmax(axis=1)
+                          for w in self.members])          # (n, batch)
+        n_classes = self._member_outputs(self.members[0], data[:1]).shape[1]
+        counts = np.apply_along_axis(
+            lambda col: np.bincount(col, minlength=n_classes), 0, votes)
+        return counts.argmax(axis=0)
+
+    def predict_mean(self, data: np.ndarray) -> np.ndarray:
+        return np.mean([self._member_outputs(w, data)
+                        for w in self.members], axis=0)
+
+    def test_classification(self) -> dict:
+        """Evaluate the committee on the validation split of member 0's
+        loader; returns committee + per-member error counts."""
+        loader = self.members[0].loader
+        off = loader.class_offset(VALID)
+        n = loader.class_lengths[VALID]
+        data = loader.original_data.map_read()[off:off + n]
+        labels = loader.original_labels.map_read()[off:off + n]
+        committee_err = int((self.predict_classes(data) != labels).sum())
+        member_errs = [
+            int((self._member_outputs(w, data).argmax(axis=1) != labels)
+                .sum()) for w in self.members]
+        self.info(f"committee err {committee_err}/{n}; members {member_errs}")
+        return {"n": n, "committee_err": committee_err,
+                "member_errs": member_errs}

@@ -1,0 +1,136 @@
+"""Forward export — rebuild of veles.znicz nn_units.py :: ForwardExporter
+and the libVeles/libZnicz inference path (SURVEY.md §4.5).
+
+The reference packaged the forward chain + weights for the C++ inference
+runtime; the TPU equivalent is an explicit package: architecture JSON
+(the StandardWorkflow layer specs) + weights npz in one file, reloadable
+into a jitted forward function with no trace of the training workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.units.nn_units import MatchingObject
+
+
+def export_forward(workflow, path: str) -> str:
+    """Package a StandardWorkflow's forward chain (layer specs + trained
+    weights) into ``path`` (.npz)."""
+    if not hasattr(workflow, "layer_specs"):
+        raise TypeError("export_forward needs a StandardWorkflow (layer "
+                        "specs carry the architecture)")
+    step = getattr(workflow, "step", None)
+    if step is not None and getattr(step, "_params", None) is not None:
+        step.sync_to_units()
+    arch = []
+    arrays = {}
+    for i, ((type_name, _unit_name, fwd_kwargs, _gd), fwd) in enumerate(
+            zip(workflow.layer_specs, workflow.forwards)):
+        arch.append({"type": type_name, "config": fwd_kwargs})
+        for attr in ("weights", "bias"):
+            arr = getattr(fwd, attr)
+            if arr:
+                arrays[f"{i}.{attr}"] = np.asarray(arr.map_read())
+    meta = {"format": "znicz_tpu.forward", "version": 1, "arch": arch,
+            "name": workflow.name,
+            "input_shape": list(workflow.loader.minibatch_data.shape[1:])}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, __arch__=np.array(json.dumps(meta)), **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+class ExportedForward:
+    """A loaded forward package: jitted inference with no workflow
+    machinery (the libZnicz-equivalent runtime)."""
+
+    def __init__(self, path: str) -> None:
+        with np.load(path, allow_pickle=False) as zf:
+            meta = json.loads(str(zf["__arch__"]))
+            if meta.get("format") != "znicz_tpu.forward":
+                raise ValueError(f"{path!r} is not a forward package")
+            self.meta = meta
+            self.arrays = {k: zf[k] for k in zf.files if k != "__arch__"}
+        self.name = meta["name"]
+        self.input_shape = tuple(meta["input_shape"])
+        self._units = []
+        # rebuild bare forward units (no workflow) for their xla_apply
+        for i, spec in enumerate(meta["arch"]):
+            cls = MatchingObject.forwards[spec["type"]]
+            unit = cls(None, **spec["config"])
+            self._units.append(unit)
+        self._params = []
+        for i in range(len(self._units)):
+            leaf = {}
+            if f"{i}.weights" in self.arrays:
+                leaf["w"] = jnp.asarray(self.arrays[f"{i}.weights"])
+            if f"{i}.bias" in self.arrays:
+                leaf["b"] = jnp.asarray(self.arrays[f"{i}.bias"])
+            self._params.append(leaf)
+        self._fn = jax.jit(self._forward)
+
+    def _forward(self, params, x):
+        for unit, p in zip(self._units, params):
+            x = unit.xla_apply(p, x, rng=None, train=False)
+        return x
+
+    def __call__(self, x) -> np.ndarray:
+        return np.asarray(self._fn(self._params, jnp.asarray(x)))
+
+
+# -- forge: local model-zoo packaging (reference: veles/forge) --------------
+def forge_publish(package_path: str, repo_dir: str, name: str,
+                  version: str = "1.0", metrics: dict | None = None) -> str:
+    """Publish a forward package into a local forge repository
+    (reference: veles forge upload; manifest.json-driven store)."""
+    entry_dir = os.path.join(repo_dir, name, version)
+    os.makedirs(entry_dir, exist_ok=True)
+    dst = os.path.join(entry_dir, "model.npz")
+    with open(package_path, "rb") as src, open(dst, "wb") as out:
+        out.write(src.read())
+    manifest = {"name": name, "version": version,
+                "metrics": metrics or {}, "file": "model.npz"}
+    with open(os.path.join(entry_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    # repo-level index
+    index_path = os.path.join(repo_dir, "index.json")
+    index = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+    index.setdefault(name, [])
+    if version not in index[name]:
+        index[name].append(version)
+    with open(index_path, "w") as f:
+        json.dump(index, f, indent=2)
+    return entry_dir
+
+
+def forge_fetch(repo_dir: str, name: str,
+                version: str | None = None) -> ExportedForward:
+    """Fetch + load a published model (reference: veles forge fetch)."""
+    index_path = os.path.join(repo_dir, "index.json")
+    with open(index_path) as f:
+        index = json.load(f)
+    if name not in index:
+        raise KeyError(f"forge repo has no model {name!r}; available: "
+                       f"{sorted(index)}")
+    version = version or sorted(index[name])[-1]
+    return ExportedForward(os.path.join(repo_dir, name, version,
+                                        "model.npz"))
+
+
+def forge_list(repo_dir: str) -> dict:
+    index_path = os.path.join(repo_dir, "index.json")
+    if not os.path.exists(index_path):
+        return {}
+    with open(index_path) as f:
+        return json.load(f)

@@ -1,0 +1,128 @@
+"""Genetic hyperparameter optimization — rebuild of veles/genetics/
+(``--optimize``; Tune leaves + GA over full training runs).
+
+Config leaves wrapped in ``Tune(default, min, max)`` (znicz_tpu.core.config)
+define the search space; each individual is a {dotted_path: value}
+assignment over the global ``root`` tree; fitness is the Decision's best
+validation metric of a complete (usually shrunk) training run.  Selection
+is top-half elitist, crossover uniform per-gene, mutation gaussian within
+the Tune range — the reference's GA shape (veles/genetics/core.py)
+without the distributed-slave evaluation plane (runs are sequential here;
+the vmap-over-configs path is the planned TPU upgrade, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import AutoDevice
+from znicz_tpu.core.config import (root, set_by_path, walk_tunes)
+from znicz_tpu.core.logger import Logger
+
+
+class Genetics(Logger):
+    """GA driver over Tune leaves (reference: veles/genetics)."""
+
+    def __init__(self, evaluate: Callable[[dict], float],
+                 tunes: Optional[dict] = None,
+                 population_size: int = 8, elite: float = 0.5,
+                 mutation_rate: float = 0.3, seed: int = 0xA11E1E) -> None:
+        super().__init__()
+        self.evaluate = evaluate
+        self.tunes = tunes if tunes is not None else dict(walk_tunes(root))
+        if not self.tunes:
+            raise ValueError("no Tune() leaves found in root — nothing to "
+                             "optimize")
+        self.population_size = population_size
+        self.elite = elite
+        self.mutation_rate = mutation_rate
+        self._gen = prng.get("genetics")
+        self.history: list[dict] = []
+
+    # -- genome ops ---------------------------------------------------------
+    def _random_individual(self) -> dict:
+        ind = {}
+        for path, tune in self.tunes.items():
+            lo, hi = float(tune.min), float(tune.max)
+            ind[path] = lo + float(self._gen.uniform(0, 1, ())) * (hi - lo)
+            if isinstance(tune.default, int):
+                ind[path] = int(round(ind[path]))
+        return ind
+
+    def _crossover(self, a: dict, b: dict) -> dict:
+        return {k: (a if float(self._gen.uniform(0, 1, ())) < 0.5
+                    else b)[k] for k in a}
+
+    def _mutate(self, ind: dict) -> dict:
+        out = dict(ind)
+        for path, tune in self.tunes.items():
+            if float(self._gen.uniform(0, 1, ())) < self.mutation_rate:
+                lo, hi = float(tune.min), float(tune.max)
+                val = out[path] + \
+                    float(self._gen.normal(0, 0.15, ())) * (hi - lo)
+                val = min(max(val, lo), hi)
+                out[path] = int(round(val)) if isinstance(tune.default, int) \
+                    else val
+        return out
+
+    # -- the loop -----------------------------------------------------------
+    def run(self, generations: int) -> tuple[dict, float]:
+        pop = [{k: (float(t.default) if not isinstance(t.default, int)
+                    else t.default) for k, t in self.tunes.items()}]
+        pop += [self._random_individual()
+                for _ in range(self.population_size - 1)]
+        best, best_fit = None, float("inf")
+        for g in range(generations):
+            scored = []
+            for ind in pop:
+                fit = float(self.evaluate(ind))
+                scored.append((fit, ind))
+                if fit < best_fit:
+                    best, best_fit = dict(ind), fit
+            scored.sort(key=lambda p: p[0])
+            self.history.append({"generation": g,
+                                 "best": scored[0][0],
+                                 "worst": scored[-1][0]})
+            self.info(f"generation {g}: best {scored[0][0]:.4f} "
+                      f"worst {scored[-1][0]:.4f}")
+            n_keep = max(2, int(self.population_size * self.elite))
+            parents = [ind for _, ind in scored[:n_keep]]
+            pop = list(parents)
+            while len(pop) < self.population_size:
+                i = int(self._gen.randint(0, len(parents)))
+                j = int(self._gen.randint(0, len(parents)))
+                pop.append(self._mutate(self._crossover(parents[i],
+                                                        parents[j])))
+        return best, best_fit
+
+
+def optimize(module, launcher, generations: int,
+             population_size: int = 8) -> dict:
+    """CLI ``--optimize`` path: GA over the Tune leaves currently in
+    ``root``; each evaluation is a full run of the workflow module with
+    the individual's values written into the tree."""
+
+    def evaluate(individual: dict) -> float:
+        for path, value in individual.items():
+            set_by_path(root, path, value)
+        prng.seed_all(prng.get("genetics").initial_seed & 0xFFFF)
+        holder = {}
+
+        def load(builder, **kwargs):
+            holder["w"] = builder(**kwargs)
+            return holder["w"], False
+
+        def main(**_):
+            holder["w"].initialize(device=launcher.device or AutoDevice())
+            holder["w"].run()
+            holder["w"].stop()
+
+        module.run(load, main)
+        metric = holder["w"].decision.best_metric
+        return float("inf") if metric is None else float(metric)
+
+    ga = Genetics(evaluate, population_size=population_size)
+    best, fit = ga.run(generations)
+    best["_fitness"] = fit
+    return best

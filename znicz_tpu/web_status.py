@@ -1,0 +1,95 @@
+"""Web status dashboard — rebuild of veles/web_status.py.
+
+The reference ran a tornado dashboard aggregating running workflows'
+progress over ZMQ (SURVEY.md §3.3 Web status row).  The rebuild is a
+minimal in-process HTTP endpoint on the TPU-VM host: ``/status.json``
+reports every registered workflow's name, epoch, metrics history and
+per-unit timing; ``/`` renders a plain HTML table of the same.  Stdlib
+``http.server`` on a daemon thread — zero dependencies, CLI ``-s``
+(stealth) simply never starts it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from znicz_tpu.core.logger import Logger
+
+
+class WebStatus(Logger):
+    """Serve live status for one or more workflows."""
+
+    def __init__(self, port: int = 0) -> None:
+        super().__init__()
+        self.workflows: list = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port = port
+
+    def register(self, workflow) -> "WebStatus":
+        self.workflows.append(workflow)
+        return self
+
+    # -- payload ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        out = []
+        for w in self.workflows:
+            dec = getattr(w, "decision", None)
+            out.append({
+                "name": w.name,
+                "epoch": (int(dec.epoch_number) if dec is not None else None),
+                "complete": bool(dec.complete) if dec is not None else None,
+                "best_metric": dec.best_metric if dec is not None else None,
+                "history": list(dec.metrics_history) if dec is not None
+                else [],
+                "units": [
+                    {"name": u.name, "runs": u.timing[0],
+                     "time_s": round(u.timing[1], 4)} for u in w.units],
+            })
+        return {"workflows": out}
+
+    # -- server -------------------------------------------------------------
+    def start(self) -> int:
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence request logging
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/status.json"):
+                    body = json.dumps(status.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    rows = "".join(
+                        f"<tr><td>{w['name']}</td><td>{w['epoch']}</td>"
+                        f"<td>{w['best_metric']}</td>"
+                        f"<td>{w['complete']}</td></tr>"
+                        for w in status.snapshot()["workflows"])
+                    body = (f"<html><body><h1>znicz_tpu status</h1>"
+                            f"<table border=1><tr><th>workflow</th>"
+                            f"<th>epoch</th><th>best</th><th>done</th></tr>"
+                            f"{rows}</table></body></html>").encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info(f"web status on http://127.0.0.1:{self.port}/")
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
