@@ -40,8 +40,17 @@ class FullBatchLoader(Loader):
         count = self.minibatch_size
         idx = indices[:count]
         data = self.minibatch_data.map_invalidate()
-        data[:count] = self.original_data.mem[idx]
-        data[count:] = 0
+        src = self.original_data.mem
+        # native threaded gather when available (bit-identical result;
+        # fill_minibatch is the host-side hot-loop bottleneck, SURVEY.md
+        # §4.1) — numpy fancy-indexing fallback otherwise
+        from znicz_tpu import native
+        if native.available() and src.flags.c_contiguous and \
+                data.flags.c_contiguous and src.dtype == data.dtype:
+            native.gather_rows(src, np.ascontiguousarray(indices), data)
+        else:
+            data[:count] = src[idx]
+            data[count:] = 0
         if self.original_labels:
             labels = self.minibatch_labels.map_invalidate()
             labels[:count] = self.original_labels.mem[idx]

@@ -1,0 +1,123 @@
+"""Native runtime pieces (C++ via ctypes) — rebuild of the reference's
+native host surface (its kernels/PRNG were the native layer; host bindings
+were pure-Python ctypes — SURVEY.md §3.2).
+
+``lib()`` compiles ``loader_core.cpp`` on first use (g++ -O3 -shared,
+cached under ``root.common.dirs.cache`` keyed by source hash) and returns
+the ctypes handle; everything degrades to numpy when no compiler is
+available (``available()`` gates call sites).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core.config import root
+
+_SRC = os.path.join(os.path.dirname(__file__), "loader_core.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = str(root.common.dirs.cache)
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"loader_core_{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        os.replace(tmp, so_path)
+    lib = ctypes.CDLL(so_path)
+    lib.xorshift128p_fill.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64]
+    lib.shuffle_indices.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64]
+    lib.gather_rows.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _build()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# -- numpy-facing wrappers --------------------------------------------------
+class XorShift128P:
+    """Native xorshift128+ stream (the reference PRNG family)."""
+
+    def __init__(self, seed: int) -> None:
+        # splitmix64 seeding, never all-zero state
+        self.state = np.empty(2, np.uint64)
+        z = np.uint64(seed or 0xDEADBEEF)
+        for i in range(2):
+            z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(2**64 - 1)
+            x = z
+            x = ((x ^ (x >> np.uint64(30))) *
+                 np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(2**64 - 1)
+            x = ((x ^ (x >> np.uint64(27))) *
+                 np.uint64(0x94D049BB133111EB)) & np.uint64(2**64 - 1)
+            self.state[i] = x ^ (x >> np.uint64(31))
+
+    def _state_ptr(self):
+        return self.state.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+    def uniform(self, n: int) -> np.ndarray:
+        out = np.empty(n, np.float32)
+        lib().xorshift128p_fill(
+            self._state_ptr(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(n))
+        return out
+
+    def shuffle(self, idx: np.ndarray) -> None:
+        assert idx.dtype == np.int64 and idx.flags.c_contiguous
+        lib().shuffle_indices(
+            self._state_ptr(),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(idx.size))
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, dst: np.ndarray,
+                n_threads: int = 0) -> None:
+    """dst[i] = src[idx[i]] (idx<0 rows zeroed) via the threaded native
+    gather; arrays must be C-contiguous with identical row layout."""
+    assert src.flags.c_contiguous and dst.flags.c_contiguous
+    assert idx.dtype == np.int64
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:]))
+    if n_threads <= 0:
+        n_threads = min(8, os.cpu_count() or 1)
+    lib().gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        np.ascontiguousarray(idx).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)),
+        dst.ctypes.data_as(ctypes.c_char_p),
+        ctypes.c_int64(idx.size), ctypes.c_int64(row_bytes),
+        ctypes.c_int(n_threads))

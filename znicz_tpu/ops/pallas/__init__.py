@@ -1,0 +1,16 @@
+"""Pallas TPU kernels — the rebuild of the reference's hand-written
+.cl/.cu kernel layer (SURVEY.md §3.2 "TPU-native mapping").
+
+Policy: XLA-native lowerings are the default everywhere (XLA already fuses
+elementwise chains into matmuls); Pallas versions exist where the
+reference's fusion/PRNG semantics are the point — the fused SGD update
+(one HBM pass over weights+velocity), dropout with in-kernel counter PRNG,
+and LRN's sliding-window pair.  Each kernel has an ``interpret=`` switch
+so the CPU test mesh can pin it against the jnp oracle
+(tests/test_pallas_kernels.py); unit code selects via
+``root.common.engine.pallas``.
+"""
+
+from znicz_tpu.ops.pallas.sgd import fused_sgd_update  # noqa: F401
+from znicz_tpu.ops.pallas.dropout import dropout_forward  # noqa: F401
+from znicz_tpu.ops.pallas.lrn import lrn_backward, lrn_forward  # noqa: F401

@@ -1,0 +1,70 @@
+"""Fused SGD update as one Pallas kernel — the explicit single-HBM-pass
+version of znicz_tpu.ops.sgd.update (reference: the weights_update /
+bias_update kernels fused normalization + decay + momentum + apply in one
+launch, gradient_descent.{cl,cu} — SURVEY.md §3.2).
+
+Weights/grad/velocity stream HBM -> VMEM tile by tile; hyperparameters
+ride SMEM as scalars; outputs alias the weight/velocity inputs (true
+in-place update, no extra HBM traffic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(h_ref, w_ref, g_ref, v_ref, w_out, v_out):
+    lr, wd, l1, mom, bs = (h_ref[0], h_ref[1], h_ref[2], h_ref[3], h_ref[4])
+    w = w_ref[:]
+    g = g_ref[:] / bs
+    g = g + wd * ((1.0 - l1) * w + l1 * jnp.sign(w))
+    vel = mom * v_ref[:] + lr * g
+    w_out[:] = w - vel
+    v_out[:] = vel
+
+
+def fused_sgd_update(w, grad, vel, learning_rate, weights_decay, l1_vs_l2,
+                     gradient_moment, batch_size, *, interpret: bool = False):
+    """(w, vel) -> (w', vel') with ops.sgd.update semantics, one pass.
+
+    Arrays of any rank (tiled over a 2-D view); hyperparams may be traced
+    scalars.  ``interpret=True`` runs the Mosaic interpreter (CPU tests).
+    """
+    orig_shape = w.shape
+    w2 = w.reshape(-1, orig_shape[-1]) if w.ndim != 2 else w
+    g2 = grad.reshape(w2.shape)
+    v2 = vel.reshape(w2.shape)
+    hyper = jnp.stack([
+        jnp.asarray(learning_rate, jnp.float32),
+        jnp.asarray(weights_decay, jnp.float32),
+        jnp.asarray(l1_vs_l2, jnp.float32),
+        jnp.asarray(gradient_moment, jnp.float32),
+        jnp.asarray(batch_size, jnp.float32)])
+    rows = w2.shape[0]
+    # row-tile so big embeddings stream through VMEM; lane dim stays whole
+    tile = rows if rows <= 512 else 256
+    grid = (pl.cdiv(rows, tile),) if rows % tile == 0 else None
+    if grid is None:      # ragged rows: single block (still one HBM pass)
+        tile, grid = rows, (1,)
+    spec = pl.BlockSpec((tile, w2.shape[1]), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    # under shard_map, outputs must declare their varying-axes type; the
+    # update preserves the weights' vma (replicated params stay replicated)
+    vma = getattr(jax.typeof(w2), "vma", None)
+    out = jax.ShapeDtypeStruct(w2.shape, w2.dtype, vma=vma)
+    w_new, v_new = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  spec, spec, spec],
+        out_specs=(spec, spec),
+        out_shape=(out, out),
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret,
+    )(hyper, w2, g2, v2)
+    return w_new.reshape(orig_shape), v_new.reshape(orig_shape)

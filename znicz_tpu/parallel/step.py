@@ -44,6 +44,7 @@ except ImportError:                # older jax
     from jax.experimental.shard_map import shard_map
 
 from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TRAIN
 from znicz_tpu.ops import sgd
@@ -189,16 +190,31 @@ class FusedTrainStep(Unit):
             loss_fn, has_aux=True)(trainable)
         bs = jax.lax.psum(mask.sum(), "data")
         metrics["bs"] = bs
+        # SGD backend: XLA-fused by default; the Pallas single-HBM-pass
+        # kernel when root.common.engine.pallas is set (SURVEY.md §3.2
+        # "fused SGD-update" kernel parity deliverable)
+        if bool(root.common.engine.get("pallas", False)):
+            from znicz_tpu.ops.pallas import fused_sgd_update
+            interp = bool(root.common.engine.get("pallas_interpret", False))
+
+            def upd(w, g, v, lr, wd, l1, mom, bsz):
+                return fused_sgd_update(w, g, v, lr, wd, l1, mom,
+                                        bsz.astype(jnp.float32),
+                                        interpret=interp)
+        else:
+            def upd(w, g, v, lr, wd, l1, mom, bsz):
+                return sgd.update(jnp, w, g, v, lr, wd, l1, mom, bsz)
+
         new_params = []
         for leaf, grad, h in zip(params, grads, hyper):
             new = dict(leaf)
             if "w" in leaf:
-                new["w"], new["vw"] = sgd.update(
-                    jnp, leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
+                new["w"], new["vw"] = upd(
+                    leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
                     h["l1"], h["mom"], bs)
             if "b" in leaf:
-                new["b"], new["vb"] = sgd.update(
-                    jnp, leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
+                new["b"], new["vb"] = upd(
+                    leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
                     h["wd_b"], h["l1"], h["mom_b"], bs)
             new_params.append(new)
         return new_params, metrics
