@@ -39,22 +39,29 @@ class FullBatchLoader(Loader):
         indices = self.minibatch_indices.mem
         count = self.minibatch_size
         idx = indices[:count]
-        data = self.minibatch_data.map_invalidate()
         src = self.original_data.mem
+        # FRESH buffer every serve: with deferred metrics the step's jit
+        # dispatch is asynchronous, so the previously served buffer may
+        # still be being read — in-place refill would race with it (the
+        # old buffer stays alive via the pending computation instead)
+        data = np.empty((self.max_minibatch_size,) + src.shape[1:],
+                        src.dtype)
         # native threaded gather when available (bit-identical result;
         # fill_minibatch is the host-side hot-loop bottleneck, SURVEY.md
-        # §4.1) — numpy fancy-indexing fallback otherwise
+        # §4.1) — numpy fancy-indexing fallback otherwise.  Both paths
+        # zero the padding rows (gather_rows memsets idx<0 rows itself).
         from znicz_tpu import native
         if native.available() and src.flags.c_contiguous and \
-                data.flags.c_contiguous and src.dtype == data.dtype:
+                src.dtype == data.dtype:
             native.gather_rows(src, np.ascontiguousarray(indices), data)
         else:
             data[:count] = src[idx]
             data[count:] = 0
+        self.minibatch_data.mem = data
         if self.original_labels:
-            labels = self.minibatch_labels.map_invalidate()
+            labels = np.zeros((self.max_minibatch_size,), np.int32)
             labels[:count] = self.original_labels.mem[idx]
-            labels[count:] = 0
+            self.minibatch_labels.mem = labels
 
 
 class FullBatchLoaderMSE(FullBatchLoader):
@@ -76,6 +83,8 @@ class FullBatchLoaderMSE(FullBatchLoader):
         super().fill_minibatch()
         indices = self.minibatch_indices.mem
         count = self.minibatch_size
-        targets = self.minibatch_targets.map_invalidate()
-        targets[:count] = self.original_targets.mem[indices[:count]]
-        targets[count:] = 0
+        src = self.original_targets.mem
+        targets = np.zeros((self.max_minibatch_size,) + src.shape[1:],
+                           src.dtype)
+        targets[:count] = src[indices[:count]]
+        self.minibatch_targets.mem = targets

@@ -141,6 +141,8 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
         up.link_attrs(down, ("err_output", "err_input"))
 
     dec.link_attrs(w.loader, "minibatch_class", "last_minibatch",
-                   "class_lengths", "epoch_number", "minibatch_size")
-    dec.link_attrs(step, ("minibatch_n_err", "n_err"))
+                   "class_lengths", "epoch_number")
+    # sample count behind the (possibly class-pass-aggregated) metrics
+    # comes from the step, not the loader — see standard_workflow.py
+    dec.link_attrs(step, ("minibatch_n_err", "n_err"), "minibatch_size")
     return w

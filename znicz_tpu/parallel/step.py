@@ -57,7 +57,8 @@ class FusedTrainStep(Unit):
 
     def __init__(self, workflow=None, forwards=None, evaluator=None,
                  gds=None, loader=None, mesh: Optional[Mesh] = None,
-                 donate: bool = True, **kwargs) -> None:
+                 donate: bool = True, defer_metrics: bool = True,
+                 **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.forwards = list(forwards or [])
         self.evaluator = evaluator
@@ -67,13 +68,24 @@ class FusedTrainStep(Unit):
         self.loader = loader
         self.mesh = mesh
         self.donate = donate
+        #: keep per-minibatch metric sums ON DEVICE and sync to host once
+        #: per class pass (at ``loader.last_minibatch``) — the hot loop
+        #: then never blocks on host scalars between steps.  The Decision
+        #: sees one aggregated "virtual minibatch" per class pass with
+        #: identical epoch totals.  ``False`` restores per-minibatch sync.
+        self.defer_metrics = defer_metrics
         self._params = None
         self._train_fn = None
         self._eval_fn = None
+        self._acc = None          # device-side metric sums (deferred mode)
         # metrics the Decision links to (mirrors the evaluator's attrs)
         self.n_err = 0
         self.mse = 0.0
         self.loss = 0.0
+        #: host mirror of the summed sample count behind the current
+        #: n_err/mse values; the Decision's ``minibatch_size`` link points
+        #: here in fused workflows
+        self.minibatch_size = 0
 
     # -- parameter pytree ---------------------------------------------------
     def gather_params(self):
@@ -271,14 +283,41 @@ class FusedTrainStep(Unit):
                 x, labels, mask)
         else:
             metrics = self._eval_fn(self._params, x, labels, mask)
-        # host-side scalars for the Decision (one device sync per minibatch;
-        # the deferred-metrics mode lands with the bench work)
-        bs = float(metrics["bs"])
-        self.loss = float(metrics["loss"])
-        if "n_err" in metrics:
-            self.n_err = int(metrics["n_err"])
-        if "mse_sum" in metrics:
-            self.mse = float(metrics["mse_sum"]) / max(bs, 1.0)
+        if not self.defer_metrics:
+            self._publish(jax.device_get(metrics))
+            return
+        # deferred mode: fold into the device-side accumulator (async tiny
+        # adds, no host sync) and only fetch at the end of the class pass
+        self._acc = metrics if self._acc is None else \
+            jax.tree.map(jnp.add, self._acc, metrics)
+        if loader.last_minibatch:
+            self._publish(jax.device_get(self._acc))
+            self._acc = None
+        else:
+            # non-final minibatches contribute zero to the Decision's
+            # accumulators; the class-pass totals land in one shot above
+            self.n_err = 0
+            self.mse = 0.0
+            self.loss = 0.0
+            self.minibatch_size = 0
+
+    def _publish(self, sums) -> None:
+        """Write (host) metric sums into the attrs the Decision reads."""
+        bs = float(sums["bs"])
+        self.minibatch_size = int(bs)
+        self.loss = float(sums["loss"])
+        if "n_err" in sums:
+            self.n_err = int(sums["n_err"])
+        if "mse_sum" in sums:
+            self.mse = float(sums["mse_sum"]) / max(bs, 1.0)
+
+    def flush_metrics(self) -> None:
+        """Sync pending deferred sums into the host mirrors (probe/debug
+        path; the training loop flushes itself per class).  ``_acc`` is NOT
+        reset — the class pass keeps accumulating, so a mid-pass flush never
+        truncates the Decision's epoch accounting."""
+        if self._acc is not None:
+            self._publish(jax.device_get(self._acc))
 
     def stop(self) -> None:
         if self._params is not None:

@@ -122,7 +122,8 @@ class StandardWorkflow(StandardWorkflowBase):
                  loss_function: str = "softmax",
                  decision_config: Optional[dict] = None,
                  snapshotter_config: Optional[dict] = None,
-                 fused: bool = True, mesh=None, **kwargs) -> None:
+                 fused: bool = True, mesh=None,
+                 defer_metrics: bool = True, **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
             raise ValueError(f"unknown loss_function {loss_function!r}")
@@ -131,6 +132,7 @@ class StandardWorkflow(StandardWorkflowBase):
         self.snapshotter_config = snapshotter_config
         self.fused = fused
         self.mesh = mesh
+        self.defer_metrics = defer_metrics
         self.snapshotter = None
         self.create_workflow()
 
@@ -218,7 +220,7 @@ class StandardWorkflow(StandardWorkflowBase):
         step = self.step = FusedTrainStep(
             self, forwards=self.forwards, evaluator=self.evaluator,
             gds=self.gds, loader=self.loader, mesh=self.mesh,
-            name="FusedStep")
+            defer_metrics=self.defer_metrics, name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control
@@ -228,6 +230,11 @@ class StandardWorkflow(StandardWorkflowBase):
             fwd.unlink_all()
         self.decision.unlink_all()
         self.decision.link_from(step)
+        # the step publishes metric sums per class pass (deferred mode) or
+        # per minibatch; either way the sample count behind them comes from
+        # the step, not the loader, so Decision's epoch accounting stays
+        # exact when metrics arrive aggregated
+        self.decision.link_attrs(step, "minibatch_size")
         if self.loss_function == "softmax":
             self.decision.link_attrs(step, ("minibatch_n_err", "n_err"))
         else:
