@@ -1,32 +1,65 @@
-"""Benchmark harness — emits ONE JSON line for the driver.
+"""Benchmark harness — always lands a parseable JSON result line.
 
 Flagship benchmark (BASELINE.md config 3 / north star): AlexNet fused
 training-step throughput, samples/sec on one chip — forward + backward +
-SGD update of the full 227x227x3 ImageNet geometry, batch 128.
+SGD update of the full 227x227x3 ImageNet geometry, batch 128 — plus
+``mfu`` (analytic FLOPs model vs the chip's dense bf16 peak).
 ``vs_baseline`` is 1.0 by convention: the reference published no numbers
 (BASELINE.json :: published == {}), so the driver-recorded history of this
 metric across rounds IS the baseline trend.
 
-Falls back to the FC benchmark if the conv stack cannot run, and says so in
-the JSON (``fallback_reason``) so a flagship regression is never silent.
+Round-1 failure mode and the defenses against it (VERDICT.md items 1b/4):
+the TPU claim through this sandbox's loopback relay can block for many
+minutes or hang outright, and round 1's monolithic bench died printing
+nothing.  Defenses:
+
+- the TPU work runs in a SUBPROCESS under a hard timeout; ONE process
+  claims the chip once and runs the cheap FC bench FIRST, flushing a full
+  result line the moment it exists, then the AlexNet flagship;
+- on timeout the parent still parses whatever lines the child flushed;
+- one retry (claims have been observed to recover after minutes), then a
+  clearly-marked CPU fallback so SOME number always lands;
+- a persistent XLA compilation cache under .data/cache/jax makes repeat
+  runs skip the 20-40s compiles.
+
+The driver reads the LAST JSON line — the best number available; every
+earlier line is a complete valid result on its own.
 """
 
 import json
-import sys
 import os
+import subprocess
+import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+CACHE_DIR = os.path.join(REPO, ".data", "cache", "jax")
+
+#: wall-clock budgets (seconds); worst-case total stays under ~25 min.
+#: Env-overridable for testing and driver tuning.
+TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", 780))
+TPU_RETRY_TIMEOUT = int(os.environ.get("BENCH_TPU_RETRY_TIMEOUT", 480))
+CPU_TIMEOUT = int(os.environ.get("BENCH_CPU_TIMEOUT", 300))
 
 
-def _throughput(workflow, x, labels, steps: int, warmup: int) -> float:
+def _enable_compile_cache():
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def _throughput(step, x, labels, steps: int, warmup: int) -> float:
     """Shared timing protocol: warmed, device-synced samples/sec of the
     fused training step on fixed host inputs."""
-    import numpy as np
     import jax
+    import numpy as np
     from znicz_tpu.core import prng
 
-    step = workflow.step
     batch = x.shape[0]
     mask = np.ones(batch, bool)
     params = step._params
@@ -42,13 +75,51 @@ def _throughput(workflow, x, labels, steps: int, warmup: int) -> float:
     return batch * steps / (time.perf_counter() - t0)
 
 
-def bench_alexnet_train(batch: int = 128, steps: int = 20, warmup: int = 3):
-    """Samples/sec of the fused AlexNet training step on one chip."""
+def _emit(metric: str, sps: float, forwards, batch: int) -> None:
+    """Flush one complete result line (mfu only when on real TPU)."""
+    import jax
+    from znicz_tpu.utils import flops
+
+    out = {"metric": metric, "value": round(sps, 1),
+           "unit": "samples/sec", "vs_baseline": 1.0}
+    if jax.default_backend() != "cpu":
+        m = flops.mfu(sps, forwards, batch)
+        if m is not None:
+            out["mfu"] = round(m, 4)
+    print(json.dumps(out), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# child: claims the device once, benches cheapest-first, flushes each line
+# ---------------------------------------------------------------------------
+
+def bench_fc(batch=1024, layers=(4096, 4096), steps=50, warmup=5):
+    import numpy as np
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models.mnist_fc import build_fused
+
+    t0 = time.time()
+    prng.seed_all(7)
+    w = build_fused(max_epochs=1, layers=layers, minibatch_size=batch,
+                    n_train=2 * batch, n_valid=0)
+    w.initialize(device=TPUDevice())
+    print(f"# fc: initialized in {time.time() - t0:.1f}s", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, batch).astype(np.int32)
+    sps = _throughput(w.step, x, labels, steps, warmup)
+    _emit(f"mnist_fc{layers[0]}_train_samples_per_sec_per_chip", sps,
+          w.forwards, batch)
+
+
+def bench_alexnet(batch=128, steps=20, warmup=3):
     import numpy as np
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
     from znicz_tpu.models.alexnet import build
 
+    t0 = time.time()
     prng.seed_all(7)
     # loader dataset is minimal (8 samples): the bench feeds _train_fn its
     # own fixed batch below; the loader only has to satisfy initialize()
@@ -56,40 +127,107 @@ def bench_alexnet_train(batch: int = 128, steps: int = 20, warmup: int = 3):
               input_size=227, n_train=8, n_valid=0,
               loader_config={"n_classes": 8})
     w.initialize(device=TPUDevice())
+    print(f"# alexnet: initialized in {time.time() - t0:.1f}s",
+          file=sys.stderr)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch, 227, 227, 3)).astype(np.float32)
     labels = rng.integers(0, 1000, batch).astype(np.int32)
-    return _throughput(w, x, labels, steps, warmup)
+    sps = _throughput(w.step, x, labels, steps, warmup)
+    _emit("alexnet_b128_train_samples_per_sec_per_chip", sps,
+          w.forwards, batch)
 
 
-def bench_fc_train(batch: int = 1024, steps: int = 50, warmup: int = 5):
-    """Fallback: samples/sec of the fused FC training step."""
-    import numpy as np
-    from znicz_tpu.core import prng
-    from znicz_tpu.core.backends import TPUDevice
-    from znicz_tpu.models.mnist_fc import build_fused
+def child_main(mode: str) -> None:
+    if mode == "cpu_fallback":
+        # the axon sitecustomize pins jax_platforms via jax.config at
+        # interpreter start — the env var alone does not stick
+        import jax
 
-    prng.seed_all(7)
-    w = build_fused(max_epochs=1, layers=(4096, 4096), minibatch_size=batch,
-                    n_train=2 * batch, n_valid=0)
-    w.initialize(device=TPUDevice())
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch, 28, 28)).astype(np.float32)
-    labels = rng.integers(0, 10, batch).astype(np.int32)
-    return _throughput(w, x, labels, steps, warmup)
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        # small geometry: a CPU figure must land inside CPU_TIMEOUT
+        bench_fc(batch=256, layers=(1024, 1024), steps=20, warmup=2)
+        return
+    _enable_compile_cache()
+    bench_fc()
+    bench_alexnet()
+
+
+# ---------------------------------------------------------------------------
+# parent orchestration
+# ---------------------------------------------------------------------------
+
+def _run_child(mode: str, timeout: int, platform=None):
+    """Run a bench child; return (json lines parsed, note)."""
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    stdout, note = "", None
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", mode],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=REPO)
+        stdout = proc.stdout or ""
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            note = f"{mode}: rc={proc.returncode} {' | '.join(tail)}"[:300]
+    except subprocess.TimeoutExpired as exc:
+        stdout = exc.stdout or ""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        note = f"{mode}: timeout after {timeout}s"
+    results = []
+    for line in stdout.strip().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                results.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return results, note
 
 
 def main():
-    result = {"unit": "samples/sec", "vs_baseline": 1.0}
-    try:
-        result["value"] = round(bench_alexnet_train(), 1)
-        result["metric"] = "alexnet_b128_train_samples_per_sec_per_chip"
-    except Exception as exc:  # noqa: BLE001
-        result["value"] = round(bench_fc_train(), 1)
-        result["metric"] = "mnist_fc4096_train_samples_per_sec_per_chip"
-        result["fallback_reason"] = f"alexnet bench failed: {exc!r}"[:200]
-    print(json.dumps(result))
+    notes = []
+    results, note = _run_child("tpu", TPU_TIMEOUT)
+    if note:
+        notes.append(note)
+    for r in results:
+        print(json.dumps(r), flush=True)
+
+    if not any(r["metric"].startswith("alexnet") for r in results):
+        more, note = _run_child("tpu", TPU_RETRY_TIMEOUT)
+        if note:
+            notes.append(note)
+        for r in more:
+            print(json.dumps(r), flush=True)
+        results += more
+
+    if not results:
+        results, note = _run_child("cpu_fallback", CPU_TIMEOUT,
+                                   platform="cpu")
+        if note:
+            notes.append(note)
+        for r in results:
+            r["metric"] += "_CPU_FALLBACK"
+            r["fallback_reason"] = "; ".join(notes)[:300] or "tpu failed"
+            print(json.dumps(r), flush=True)
+
+    if results:
+        best = results[-1]
+        if notes and "fallback_reason" not in best:
+            best["notes"] = "; ".join(notes)[:300]
+            print(json.dumps(best), flush=True)
+    else:
+        print(json.dumps({
+            "metric": "alexnet_b128_train_samples_per_sec_per_chip",
+            "value": 0.0, "unit": "samples/sec", "vs_baseline": 0.0,
+            "error": "; ".join(notes)[:500]}), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+    else:
+        main()
