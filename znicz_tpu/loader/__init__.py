@@ -2,14 +2,28 @@
 
 ``Loader`` serves fixed-size minibatches across the TEST/VALID/TRAIN sample
 classes each epoch with deterministic shuffling; ``FullBatchLoader`` holds
-the whole dataset in one Array (optionally device-resident).
+the whole dataset in one Array (optionally device-resident).  File-backed
+loaders (IDX MNIST, directory-per-class images, CIFAR pickle batches) read
+from ``root.common.dirs.datasets`` and synthesize seeded stand-in FILES
+once when the real datasets are absent (zero-egress sandbox), so the
+file -> decode -> normalize -> minibatch path always runs for real.
 """
 
 from znicz_tpu.loader.base import (Loader, TEST, VALID, TRAIN, CLASS_NAMES,
                                    register_loader, get_loader)
 from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+from znicz_tpu.loader.normalization import (NORMALIZER_REGISTRY,
+                                            normalizer_factory)
 from znicz_tpu.loader import synthetic  # noqa: F401  (registry population)
+from znicz_tpu.loader import mnist      # noqa: F401  (registry population)
+from znicz_tpu.loader import image     # noqa: F401  (registry population)
+from znicz_tpu.loader import pickles   # noqa: F401  (registry population)
+from znicz_tpu.loader.mnist import MnistLoader
+from znicz_tpu.loader.image import FileImageLoader, FullBatchImageLoader
+from znicz_tpu.loader.pickles import PicklesImageLoader
 
 __all__ = ["Loader", "FullBatchLoader", "FullBatchLoaderMSE",
+           "MnistLoader", "FileImageLoader", "FullBatchImageLoader",
+           "PicklesImageLoader", "NORMALIZER_REGISTRY", "normalizer_factory",
            "TEST", "VALID", "TRAIN", "CLASS_NAMES",
            "register_loader", "get_loader"]

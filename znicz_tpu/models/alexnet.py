@@ -59,17 +59,28 @@ def build(max_epochs: int = 1, minibatch_size: int = 128,
           n_classes: int = 1000, input_size: int = 227,
           n_train: int = 1000, n_valid: int = 0, lr: float = 0.01,
           dropout: float = 0.5, fused: bool = True, mesh=None,
+          loader_name: str = "synthetic_image",
           loader_config: dict | None = None,
           snapshotter_config: dict | None = None) -> StandardWorkflow:
-    cfg = {"n_classes": min(n_classes, 50),
-           "sample_shape": (input_size, input_size, 3),
-           "n_train": n_train, "n_valid": n_valid,
-           "minibatch_size": minibatch_size, "spread": 1.0, "noise": 0.5}
+    """``loader_name="file_image"`` + ``loader_config={"data_dir": ...}``
+    streams a directory-per-class ImageNet-style tree with fitted
+    mean_disp normalization (the real-data path); the synthetic in-memory
+    loader stays the default so the flagship bench never touches disk."""
+    if loader_name == "file_image":
+        cfg = {"sample_shape": (input_size, input_size, 3),
+               "minibatch_size": minibatch_size,
+               "normalization_type": "mean_disp"}
+    else:
+        cfg = {"n_classes": min(n_classes, 50),
+               "sample_shape": (input_size, input_size, 3),
+               "n_train": n_train, "n_valid": n_valid,
+               "minibatch_size": minibatch_size, "spread": 1.0,
+               "noise": 0.5}
     cfg.update(loader_config or {})
     return StandardWorkflow(
         name="AlexNet",
         layers=layers(n_classes=n_classes, lr=lr, dropout=dropout),
-        loss_function="softmax", loader_name="synthetic_image",
+        loss_function="softmax", loader_name=loader_name,
         loader_config=cfg,
         decision_config={"max_epochs": max_epochs},
         snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)

@@ -1,9 +1,11 @@
 """CIFAR-10 ConvRELU workflow (reference: veles.znicz samples/CIFAR10/
 cifar.py — the ConvRELU benchmark workflow in BASELINE.json).
 
-Conv/pool stack + dropout head, declarative StandardWorkflow form;
-synthetic CIFAR-shaped data by default (SURVEY.md §5 fixtures).  (LRN
-belongs to AlexNet-style stacks, as in the reference.)
+Conv/pool stack + dropout head, declarative StandardWorkflow form.
+Default data path reads CIFAR python-format pickle batches from
+``root.common.dirs.datasets/cifar`` (real files used as-is; a seeded
+CIFAR-format set is synthesized once otherwise).  (LRN belongs to
+AlexNet-style stacks, as in the reference.)
 """
 
 from __future__ import annotations
@@ -13,32 +15,39 @@ from znicz_tpu.standard_workflow import StandardWorkflow
 LAYERS = [
     {"type": "conv_relu", "->": {"n_kernels": 32, "kx": 3, "ky": 3,
                                  "padding": (1, 1, 1, 1)},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 1e-4}},
     {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
     {"type": "conv_relu", "->": {"n_kernels": 64, "kx": 3, "ky": 3,
                                  "padding": (1, 1, 1, 1)},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 1e-4}},
     {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
     {"type": "dropout", "->": {"dropout_ratio": 0.3}},
     {"type": "all2all_relu", "->": {"output_sample_shape": 256},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 1e-4}},
     {"type": "softmax", "->": {"output_sample_shape": 10},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 1e-4}},
 ]
 
 
 def build(max_epochs: int = 10, minibatch_size: int = 100,
           n_train: int = 2000, n_valid: int = 500, fused: bool = True,
-          mesh=None, loader_name: str = "synthetic_image",
+          mesh=None, loader_name: str = "pickles_image",
           loader_config: dict | None = None,
           snapshotter_config: dict | None = None) -> StandardWorkflow:
-    cfg = {"n_classes": 10, "sample_shape": (32, 32, 3),
-           "n_train": n_train, "n_valid": n_valid,
-           "minibatch_size": minibatch_size, "spread": 2.0, "noise": 1.0}
+    if loader_name == "pickles_image":
+        # CIFAR python-batch pickle files (real ones when dropped into
+        # root.common.dirs.datasets/cifar, synthesized otherwise)
+        cfg = {"n_train": n_train, "n_valid": n_valid,
+               "minibatch_size": minibatch_size, "sample_shape": (32, 32, 3)}
+    else:
+        cfg = {"n_classes": 10, "sample_shape": (32, 32, 3),
+               "n_train": n_train, "n_valid": n_valid,
+               "minibatch_size": minibatch_size, "spread": 2.0,
+               "noise": 1.0}
     cfg.update(loader_config or {})
     return StandardWorkflow(
         name="CifarConv", layers=LAYERS, loss_function="softmax",

@@ -1,9 +1,12 @@
 """MNIST convolutional workflow (reference: veles.znicz samples/MNIST conv
 config — BASELINE.md config 2 "MNIST-conv to 99%").
 
-Declarative StandardWorkflow description; data is the seeded synthetic
-MNIST stand-in by default (no egress in the sandbox — SURVEY.md §5
-fixtures), a real-MNIST loader drops in via ``loader_name``.
+Declarative StandardWorkflow description.  Default data path is the IDX
+FILE loader (znicz_tpu.loader.mnist): real MNIST files when present under
+``root.common.dirs.datasets/mnist``, a deterministically synthesized IDX
+quartet otherwise — either way the file -> decode -> normalize ->
+minibatch pipeline runs.  ``loader_name="synthetic_image"`` restores the
+in-memory stand-in (benchmarks that shouldn't touch disk).
 """
 
 from __future__ import annotations
@@ -13,31 +16,37 @@ from znicz_tpu.standard_workflow import StandardWorkflow
 LAYERS = [
     {"type": "conv_relu", "->": {"n_kernels": 32, "kx": 5, "ky": 5,
                                  "padding": (2, 2, 2, 2)},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 5e-4}},
     {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
     {"type": "conv_relu", "->": {"n_kernels": 64, "kx": 5, "ky": 5,
                                  "padding": (2, 2, 2, 2)},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 5e-4}},
     {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
     {"type": "all2all_relu", "->": {"output_sample_shape": 128},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 5e-4}},
     {"type": "softmax", "->": {"output_sample_shape": 10},
-     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9,
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
             "weights_decay": 5e-4}},
 ]
 
 
 def build(max_epochs: int = 10, minibatch_size: int = 100,
           n_train: int = 2000, n_valid: int = 500, fused: bool = True,
-          mesh=None, loader_name: str = "synthetic_image",
+          mesh=None, loader_name: str = "mnist",
           loader_config: dict | None = None,
           snapshotter_config: dict | None = None) -> StandardWorkflow:
-    cfg = {"n_classes": 10, "sample_shape": (28, 28, 1),
-           "n_train": n_train, "n_valid": n_valid,
-           "minibatch_size": minibatch_size, "spread": 2.5, "noise": 1.0}
+    if loader_name == "mnist":
+        cfg = {"n_train": n_train, "n_valid": n_valid,
+               "minibatch_size": minibatch_size,
+               "normalization_type": "linear"}
+    else:
+        cfg = {"n_classes": 10, "sample_shape": (28, 28, 1),
+               "n_train": n_train, "n_valid": n_valid,
+               "minibatch_size": minibatch_size, "spread": 2.5,
+               "noise": 1.0}
     cfg.update(loader_config or {})
     return StandardWorkflow(
         name="MnistConv", layers=LAYERS, loss_function="softmax",

