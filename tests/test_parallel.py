@@ -100,6 +100,60 @@ def test_make_mesh_axes(cpu_devices):
         make_mesh({"data": 16})
 
 
+def test_train_steps_scan_matches_sequential(cpu_devices):
+    """The K-step scan (the bench's measurement path) is the SAME program
+    as K sequential per-minibatch steps: identical final params and summed
+    metrics; and the device hyper cache invalidates on an LR change."""
+    import jax.numpy as jnp
+
+    mesh = data_parallel_mesh(4)
+
+    def fresh():
+        prng.seed_all(23)
+        w = build_fused(max_epochs=1, n_valid=0, n_train=240,
+                        minibatch_size=40, mesh=mesh)
+        w.initialize(device=TPUDevice())
+        return w
+
+    rng = np.random.default_rng(3)
+    K = 5
+    xs = rng.normal(size=(K, 40, 28, 28)).astype(np.float32)
+    ys = rng.integers(0, 10, (K, 40)).astype(np.int32)
+    ms = np.ones((K, 40), bool)
+
+    w_seq = fresh()
+    seq_sums = None
+    for k in range(K):
+        w_seq.step._params, w_seq.step._key, metrics = w_seq.step._train_fn(
+            w_seq.step._params, w_seq.step._key,
+            w_seq.step._hyper_device(), xs[k], ys[k], ms[k])
+        host = jax.device_get(metrics)
+        seq_sums = host if seq_sums is None else \
+            jax.tree.map(np.add, seq_sums, host)
+
+    w_scan = fresh()
+    scan_sums = jax.device_get(w_scan.step.train_steps(
+        jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ms)))
+
+    for leaf_seq, leaf_scan in zip(jax.tree.leaves(w_seq.step._params),
+                                   jax.tree.leaves(w_scan.step._params)):
+        np.testing.assert_allclose(np.asarray(leaf_seq),
+                                   np.asarray(leaf_scan),
+                                   rtol=1e-5, atol=1e-6)
+    assert int(seq_sums["n_err"]) == int(scan_sums["n_err"])
+    np.testing.assert_allclose(float(seq_sums["loss"]),
+                               float(scan_sums["loss"]), rtol=1e-5)
+    assert int(seq_sums["bs"]) == int(scan_sums["bs"]) == K * 40
+
+    # hyper cache: an LR change must produce a DIFFERENT device pytree
+    h0 = w_scan.step._hyper_device()
+    for gd in w_scan.gds:
+        gd.learning_rate *= 0.5
+    h1 = w_scan.step._hyper_device()
+    assert float(jax.device_get(h1[0]["lr"])) == \
+        0.5 * float(jax.device_get(h0[0]["lr"]))
+
+
 def test_lr_schedule_no_recompile(cpu_devices):
     """Hyperparams are traced scalars: mutating gd.learning_rate between
     steps must not retrigger compilation."""

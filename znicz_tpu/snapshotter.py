@@ -22,6 +22,8 @@ from typing import Optional
 
 import numpy as np
 
+import jax
+
 from znicz_tpu.core import prng
 from znicz_tpu.core.units import Unit
 
@@ -38,16 +40,22 @@ def collect_state(workflow) -> tuple[dict, dict]:
     if step is not None and getattr(step, "_params", None) is not None:
         step.sync_to_units()  # device params -> unit Arrays
     arrays: dict[str, np.ndarray] = {}
+    # three-arg getattr: non-standard forwards (KohonenTrainer has no bias)
+    # simply contribute fewer arrays
     for i, fwd in enumerate(workflow.forwards):
         for attr in ("weights", "bias"):
-            arr = getattr(fwd, attr)
+            arr = getattr(fwd, attr, None)
             if arr:
                 arrays[f"forward.{i}.{attr}"] = np.asarray(arr.map_read())
     for i, gd in enumerate(getattr(workflow, "gds", []) or []):
         for attr in ("gradient_weights", "gradient_bias"):
-            arr = getattr(gd, attr)
+            arr = getattr(gd, attr, None)
             if arr:
                 arrays[f"gd.{i}.{attr}"] = np.asarray(arr.map_read())
+    if step is not None and getattr(step, "_key", None) is not None:
+        # the device-resident PRNG key is training state: per-step keys are
+        # split from it, so bit-exact resume must restore it
+        arrays["step.key"] = np.asarray(jax.device_get(step._key))
     loader_state = workflow.loader.state_dict()
     for cls, order in loader_state.pop("shuffled").items():
         arrays[f"loader.shuffled.{cls}"] = np.asarray(order)
@@ -75,13 +83,14 @@ def restore_state(workflow, path: str) -> dict:
     targets: dict[str, object] = {}
     for i, fwd in enumerate(workflow.forwards):
         for attr in ("weights", "bias"):
-            if getattr(fwd, attr):
+            if getattr(fwd, attr, None):
                 targets[f"forward.{i}.{attr}"] = getattr(fwd, attr)
     for i, gd in enumerate(getattr(workflow, "gds", []) or []):
         for attr in ("gradient_weights", "gradient_bias"):
-            if getattr(gd, attr):
+            if getattr(gd, attr, None):
                 targets[f"gd.{i}.{attr}"] = getattr(gd, attr)
-    param_keys = {k for k in arrays if not k.startswith("loader.")}
+    param_keys = {k for k in arrays
+                  if not k.startswith(("loader.", "step."))}
     if param_keys != set(targets):
         raise ValueError(
             f"snapshot/workflow architecture mismatch: snapshot-only keys "
@@ -103,6 +112,11 @@ def restore_state(workflow, path: str) -> dict:
     step = getattr(workflow, "step", None)
     if step is not None and getattr(step, "_params", None) is not None:
         step._params = step.gather_params()  # re-place restored weights
+        if "step.key" in arrays:
+            from jax.sharding import NamedSharding, PartitionSpec
+            step._key = jax.device_put(
+                arrays["step.key"],
+                NamedSharding(step.mesh, PartitionSpec()))
     return meta
 
 

