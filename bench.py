@@ -4,9 +4,10 @@ Flagship benchmark (BASELINE.md config 3 / north star): AlexNet fused
 training-step throughput, samples/sec on one chip — forward + backward +
 SGD update of the full 227x227x3 ImageNet geometry, batch 128 — plus
 ``mfu`` (analytic FLOPs model vs the chip's dense bf16 peak).
-``vs_baseline`` is 1.0 by convention: the reference published no numbers
-(BASELINE.json :: published == {}), so the driver-recorded history of this
-metric across rounds IS the baseline trend.
+``vs_baseline`` is the cross-round trend — current value over the newest
+driver-recorded ``BENCH_r*.json`` for the same metric (the reference
+published no absolute numbers; BASELINE.json :: published == {}).  1.0
+means "no prior round measured this metric".
 
 Round-1 failure mode and the defenses against it (VERDICT.md items 1b/4):
 the TPU claim through this sandbox's loopback relay can block for many

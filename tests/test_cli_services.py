@@ -109,6 +109,57 @@ def test_genetics_pure_function():
     assert abs(best["x"] - 3.0) < 1.5
 
 
+def test_ga_evaluations_share_one_seed_and_private_stream(monkeypatch):
+    """Every GA evaluation must see IDENTICAL session-stream state (fitness
+    comparability), and the reseed must not restart the GA's own draws
+    (r1 advisor: utils/genetics.py reseed drift)."""
+    from znicz_tpu.utils import genetics as gmod
+
+    set_by_path(root, "ga_seed_test.lr", Tune(0.3, 0.01, 1.0))
+    seen = []
+
+    class FakeModule:
+        @staticmethod
+        def run(load, main):
+            # what a workflow does first: draw from the session stream
+            seen.append(float(prng.get().uniform(0.0, 1.0, (1,))[0]))
+            w, _ = load(lambda **kw: _FakeWorkflow())
+            main()
+
+    class _FakeDecision:
+        best_metric = 1.0
+
+    class _FakeWorkflow:
+        decision = _FakeDecision()
+
+        def initialize(self, **kw):
+            pass
+
+        def run(self):
+            pass
+
+        def stop(self):
+            pass
+
+    class _FakeLauncher:
+        device = None
+
+    prng.seed_all(9)
+    gmod.optimize(FakeModule, _FakeLauncher(), generations=2,
+                  population_size=4)
+    assert len(seen) == 8
+    assert len(set(seen)) == 1, \
+        f"evaluations saw drifting session seeds: {seen}"
+    del root.ga_seed_test
+
+    # the GA's private stream is untouched by seed_all
+    ga = Genetics(lambda ind: 0.0, tunes={"x": Tune(0.0, -1.0, 1.0)})
+    before = float(ga._gen.uniform(0.0, 1.0, (1,))[0])
+    prng.seed_all(9)
+    after = float(ga._gen.uniform(0.0, 1.0, (1,))[0])
+    assert before != after  # stream advanced, was not reset to the start
+
+
 def test_ensemble_committee(tmp_path):
     ens = Ensemble(wine.build, n_members=3, base_seed=50, max_epochs=3,
                    n_train=60, n_valid=30, minibatch_size=10)

@@ -132,6 +132,36 @@ def test_avg_pooling_numpy_vs_xla_and_border_counts(geom):
                                1.0, rtol=1e-6)
 
 
+@pytest.mark.parametrize("geom", POOL_GEOMS)
+@pytest.mark.parametrize("kind", ["max", "maxabs", "avg"])
+def test_fast_pooling_matches_eager_values_and_grads(geom, kind):
+    """The reduce_window fused-path pooling must match the patch-tensor
+    eager path in VALUES and GRADIENTS on every border geometry — the
+    flagship bench trains through the fast path."""
+    import jax
+
+    h, w, ky, kx, sl = geom
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(2, h, w, 3)).astype(np.float32)
+    xj = jnp.asarray(x)
+    if kind == "max":
+        eager = lambda a: pool_ops.max_forward(jnp, a, ky, kx, *sl)[0]
+        fast = lambda a: pool_ops.max_forward_fast(a, ky, kx, *sl)
+    elif kind == "maxabs":
+        eager = lambda a: pool_ops.max_forward(jnp, a, ky, kx, *sl,
+                                               use_abs=True)[0]
+        fast = lambda a: pool_ops.maxabs_forward_fast(a, ky, kx, *sl)
+    else:
+        eager = lambda a: pool_ops.avg_forward(jnp, a, ky, kx, *sl)
+        fast = lambda a: pool_ops.avg_forward_fast(a, ky, kx, *sl)
+    np.testing.assert_allclose(np.asarray(fast(xj)), np.asarray(eager(xj)),
+                               rtol=1e-6, atol=1e-6)
+    g_fast = jax.grad(lambda a: (fast(a) ** 2).sum())(xj)
+    g_eager = jax.grad(lambda a: (eager(a) ** 2).sum())(xj)
+    np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_eager),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_max_pool_scatter_roundtrip():
     rng = np.random.default_rng(6)
     x = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)

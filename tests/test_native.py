@@ -43,7 +43,7 @@ def test_xorshift_stream():
     # deterministic per seed, advancing state
     gen2 = native.XorShift128P(42)
     np.testing.assert_array_equal(gen2.uniform(100_000), u)
-    assert not np.array_equal(gen.uniform(8), gen2.uniform(8)[::-1]) or True
+    assert not np.array_equal(gen.uniform(8), gen.uniform(8))
     assert not np.array_equal(native.XorShift128P(43).uniform(100),
                               native.XorShift128P(42).uniform(100))
 

@@ -83,6 +83,30 @@ def test_snapshot_roundtrip_arrays(tmp_path):
         arrays["gd.0.gradient_weights"])
 
 
+def test_snapshot_kohonen_workflow(tmp_path):
+    """Regression (r1 advisor): KohonenTrainer sits in ``forwards`` but has
+    no ``bias`` — collect_state/restore_state must tolerate non-standard
+    forwards instead of raising AttributeError."""
+    from znicz_tpu.models import kohonen as kohonen_model
+
+    prng.seed_all(23)
+    w = kohonen_model.build(max_epochs=2, shape=(6, 6), n_train=200)
+    w.initialize(device=TPUDevice())
+    w.run()
+    arrays, meta = collect_state(w)
+    assert "forward.0.weights" in arrays
+    assert "forward.0.bias" not in arrays
+    path = str(tmp_path / "som.npz")
+    write_snapshot(path, arrays, meta)
+
+    prng.seed_all(9)
+    w2 = kohonen_model.build(max_epochs=2, shape=(6, 6), n_train=200)
+    w2.initialize(device=TPUDevice())
+    restore_state(w2, path)
+    np.testing.assert_array_equal(w2.trainer.weights.map_read(),
+                                  arrays["forward.0.weights"])
+
+
 def test_only_improved_and_latest_symlink(tmp_path):
     w = build(3, tmp_path, only_improved=True, keep_all=False)
     w.snapshotter.only_improved = True

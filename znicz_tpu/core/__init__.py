@@ -3,5 +3,6 @@
 Rebuilds the substrate layers of the reference (SURVEY.md §2 L1-L4):
 veles/config.py, veles/logger.py, veles/prng/, veles/memory.py,
 veles/mutable.py, veles/units.py, veles/workflow.py, veles/plumbing.py,
-veles/backends.py, veles/accelerated_units.py, veles/distributable.py.
+veles/backends.py, veles/accelerated_units.py.  (veles/distributable.py
+is designed away: see the Unit docstring in units.py.)
 """

@@ -26,7 +26,6 @@ from __future__ import annotations
 import time
 from typing import TYPE_CHECKING, Dict, Optional
 
-from znicz_tpu.core.distributable import Distributable
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.mutable import Bool, LinkableAttribute
 
@@ -34,9 +33,16 @@ if TYPE_CHECKING:
     from znicz_tpu.core.workflow import Workflow
 
 
-class Unit(Logger, Distributable):
-    """Base control/data-graph node.  Inherits the Distributable protocol
-    stubs (reference: every Unit is Distributable)."""
+class Unit(Logger):
+    """Base control/data-graph node.
+
+    The reference additionally mixes a 5-method Distributable protocol
+    into every unit (veles/distributable.py — master/slave payloads over
+    ZeroMQ).  That protocol has no TPU equivalent by design: the gradient
+    plane is a ``lax.psum`` inside the compiled step and host-side state
+    travels through the snapshotter's explicit state dicts (SURVEY.md
+    §3.4 "the entire protocol disappears"), so no vestigial mixin is
+    kept."""
 
     def __init__(self, workflow: Optional["Workflow"] = None,
                  name: Optional[str] = None, **kwargs) -> None:

@@ -135,5 +135,3 @@ class Workflow(Unit):
             lines.append(
                 f"{name:<28}{count:>8}{run_time:>10.3f}{run_time / total:>8.1%}")
         return "\n".join(lines)
-
-# (distributed state protocol: inherited from Distributable via Unit)

@@ -7,9 +7,8 @@ Reference behavior kept: images live on disk; the loader scans a directory
 tree where each subdirectory name is a class label, splits deterministically
 into train/validation, decodes + rescales per minibatch (streaming — the
 whole dataset is never materialized), and applies a fitted normalizer.
-TPU-native differences: decode happens into FRESH per-minibatch buffers
-(async-dispatch safety, see fullbatch.py) and the decode loop uses the
-native C++ gather/threading helpers when available.
+TPU-native difference: decode happens into FRESH per-minibatch buffers
+(async-dispatch safety, see fullbatch.py).
 
 ``synthesize_image_dataset`` writes a seeded PNG tree once so the
 file->decode->normalize->minibatch path is exercised end-to-end in a

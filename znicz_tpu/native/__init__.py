@@ -74,17 +74,18 @@ class XorShift128P:
     """Native xorshift128+ stream (the reference PRNG family)."""
 
     def __init__(self, seed: int) -> None:
-        # splitmix64 seeding, never all-zero state
+        # splitmix64 seeding, never all-zero state; arithmetic in Python
+        # ints (arbitrary precision — numpy uint64 scalars raise overflow
+        # RuntimeWarnings on the wrapping multiplies), stored as uint64
         self.state = np.empty(2, np.uint64)
-        z = np.uint64(seed or 0xDEADBEEF)
+        mask = (1 << 64) - 1
+        z = int(seed or 0xDEADBEEF) & mask
         for i in range(2):
-            z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(2**64 - 1)
+            z = (z + 0x9E3779B97F4A7C15) & mask
             x = z
-            x = ((x ^ (x >> np.uint64(30))) *
-                 np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(2**64 - 1)
-            x = ((x ^ (x >> np.uint64(27))) *
-                 np.uint64(0x94D049BB133111EB)) & np.uint64(2**64 - 1)
-            self.state[i] = x ^ (x >> np.uint64(31))
+            x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & mask
+            x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & mask
+            self.state[i] = np.uint64(x ^ (x >> 31))
 
     def _state_ptr(self):
         return self.state.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))

@@ -37,7 +37,10 @@ class Genetics(Logger):
         self.population_size = population_size
         self.elite = elite
         self.mutation_rate = mutation_rate
-        self._gen = prng.get("genetics")
+        #: PRIVATE stream, not in the prng registry: evaluations reseed the
+        #: session streams (so every individual trains on identical data),
+        #: and that reseed must not restart the GA's own draws
+        self._gen = prng.RandomGenerator("genetics-private", seed)
         self.history: list[dict] = []
 
     # -- genome ops ---------------------------------------------------------
@@ -103,10 +106,16 @@ def optimize(module, launcher, generations: int,
     ``root``; each evaluation is a full run of the workflow module with
     the individual's values written into the tree."""
 
+    # ONE fixed evaluation seed, captured before any evaluation runs:
+    # every individual then trains on identical data/init, so fitness
+    # values are comparable (the old per-call re-derivation drifted the
+    # seed between evaluations AND restarted the GA's own stream)
+    eval_seed = prng.get("genetics").initial_seed & 0xFFFF
+
     def evaluate(individual: dict) -> float:
         for path, value in individual.items():
             set_by_path(root, path, value)
-        prng.seed_all(prng.get("genetics").initial_seed & 0xFFFF)
+        prng.seed_all(eval_seed)
         holder = {}
 
         def load(builder, **kwargs):
