@@ -1,8 +1,17 @@
 """Tier-2 sample-zoo tests: each models/ entry builds, trains a few epochs
-on TPU/XLA, and its validation metric improves (SURVEY.md §5 tier-2 —
-shrunk configs, seeded determinism)."""
+on TPU/XLA, and the metric history matches EXACT pinned seeded values —
+the reference's functional tests pin integer error counts the same way
+(SURVEY.md §5 tier-2).  Any numeric drift in ops, loaders, PRNG streams or
+the fused step fails these, not just "did it improve".
 
-import pytest
+Values were captured on the virtual-CPU platform (tests/conftest.py) —
+the platform every CI run uses — with f32 compute (the fused step's CPU
+dtype), so they are bit-stable run to run.
+"""
+
+import time
+
+import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.backends import TPUDevice
@@ -19,30 +28,37 @@ def _train(build, seed=31, **kw):
     return w.decision.metrics_history
 
 
+def _validation(hist):
+    return [int(h["metric_validation"]) for h in hist]
+
+
 def test_wine_sample():
     hist = _train(wine.build, max_epochs=10)
-    assert hist[-1]["metric_validation"] <= hist[0]["metric_validation"]
-    assert hist[-1]["metric_validation"] <= 3, hist
+    assert _validation(hist) == [19, 0, 0, 0, 0, 0, 0, 0, 0, 0], hist
+    assert int(hist[0]["metric_train"]) == 8, hist
 
 
 def test_mnist_conv_sample():
     hist = _train(mnist_conv.build, max_epochs=3, n_train=300, n_valid=100,
                   minibatch_size=50)
-    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"] or \
-        hist[-1]["metric_validation"] == 0, hist
+    assert _validation(hist) == [94, 92, 90], hist
+    assert [int(h["metric_train"]) for h in hist] == [268, 256, 263], hist
 
 
 def test_cifar_conv_sample():
     hist = _train(cifar_conv.build, max_epochs=3, n_train=300, n_valid=100,
                   minibatch_size=50)
-    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"] or \
-        hist[-1]["metric_validation"] == 0, hist
+    assert _validation(hist) == [92, 90, 88], hist
+    assert [int(h["metric_train"]) for h in hist] == [267, 271, 278], hist
 
 
 def test_autoencoder_sample():
     hist = _train(autoencoder.build, max_epochs=4, n_train=200, n_valid=64,
                   sample_shape=(12, 12, 1))
-    assert hist[-1]["metric_validation"] < hist[0]["metric_validation"], hist
+    np.testing.assert_allclose(
+        [h["metric_validation"] for h in hist],
+        [1.2079215, 0.39782357, 0.32945922, 0.25455874],
+        rtol=1e-5, err_msg=str(hist))
 
 
 def test_alexnet_sample():
@@ -52,8 +68,24 @@ def test_alexnet_sample():
     hist = _train(alexnet.build, seed=1, max_epochs=5, minibatch_size=50,
                   n_classes=10, input_size=67, n_train=300, n_valid=100,
                   lr=0.003, dropout=0.2, loader_config={"spread": 2.0})
-    assert hist[-1]["metric_validation"] <= 0.2 * hist[0]["metric_validation"], \
-        hist
+    assert _validation(hist) == [90, 73, 38, 0, 0], hist
+
+
+def test_mnist_conv_reaches_two_percent():
+    """BASELINE.md config 2 ("MNIST-conv wall-clock to 99%") at CI scale:
+    the full IDX pipeline at n_train=2000 must reach <= 2% validation
+    error (10 of 500) within 12 epochs, wall-clock reported.  The early
+    epochs are pinned exactly; the tail is thresholded (it sits at the
+    scale of single samples)."""
+    t0 = time.time()
+    hist = _train(mnist_conv.build, max_epochs=12, n_train=2000,
+                  n_valid=500, minibatch_size=100)
+    wall = time.time() - t0
+    val = _validation(hist)
+    assert val[:6] == [451, 443, 411, 315, 228, 128], hist
+    assert val[-1] <= 10, hist
+    print(f"\nmnist_conv to {val[-1]}/500 errors in {len(hist)} epochs, "
+          f"{wall:.1f}s wall")
 
 
 def test_run_load_main_shape():

@@ -8,7 +8,9 @@ best validation result, flip the ``improved`` / ``epoch_ended`` /
 Repeater loop (SURVEY.md §4.1).
 
 Stop conditions (reference semantics): ``max_epochs`` reached, or no
-validation improvement within the last ``fail_iterations`` epochs.
+validation improvement within the last ``fail_iterations`` epochs; plus
+``target_metric`` — stop as soon as the watched metric reaches a target
+(the "train to 99%" contract of BASELINE.md config 2).
 """
 
 from __future__ import annotations
@@ -26,10 +28,12 @@ class DecisionBase(Unit):
     """Shared epoch bookkeeping (reference: decision.py :: DecisionBase)."""
 
     def __init__(self, workflow=None, max_epochs: Optional[int] = None,
-                 fail_iterations: int = 100, **kwargs) -> None:
+                 fail_iterations: int = 100,
+                 target_metric: Optional[float] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.max_epochs = max_epochs
         self.fail_iterations = fail_iterations
+        self.target_metric = target_metric
         # data-linked from the loader:
         self.minibatch_class = TRAIN
         self.last_minibatch = False
@@ -95,6 +99,9 @@ class DecisionBase(Unit):
                 int(self.epoch_number) >= self.max_epochs:
             self.complete.set(True)
         if int(self.epoch_number) - self.best_epoch >= self.fail_iterations:
+            self.complete.set(True)
+        if self.target_metric is not None and watched is not None and \
+                watched <= self.target_metric:
             self.complete.set(True)
         self.reset_epoch()
 
