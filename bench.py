@@ -28,6 +28,7 @@ The driver reads the LAST JSON line — the best number available; every
 earlier line is a complete valid result on its own.
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -69,7 +70,9 @@ def _throughput(step, x, labels, K: int = 8, reps: int = 3) -> float:
 
     batch = x.shape[0]
     xs = jnp.asarray(np.stack([np.roll(x, k, axis=0) for k in range(K)]))
-    ys = jnp.asarray(np.stack([np.roll(labels, k) for k in range(K)]))
+    # roll on the batch axis only — labels may be image targets (MSE)
+    ys = jnp.asarray(np.stack([np.roll(labels, k, axis=0)
+                               for k in range(K)]))
     ms = jnp.ones((K, batch), bool)
     jax.device_get(xs[0, 0, 0])          # fence the staging transfers
 
@@ -86,9 +89,6 @@ def _throughput(step, x, labels, K: int = 8, reps: int = 3) -> float:
     if profile_dir:
         jax.profiler.stop_trace()
     return batch * K * reps / dt
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=1)

@@ -115,3 +115,199 @@ def test_lrn_kernels_match_oracle(n):
                         interpret=True)
     np.testing.assert_allclose(np.asarray(e_pl), e_ref, rtol=1e-4,
                                atol=1e-5)
+
+
+# -- round-3 parity tail: conv, stochastic pooling, kohonen ------------------
+
+from znicz_tpu.ops import conv as conv_ops, kohonen as k_ops
+from znicz_tpu.ops import pooling as pool_ops
+from znicz_tpu.ops.pallas import conv2d_im2col, som_step, stochastic_pool
+
+CONV_GEOMS = [
+    # (h, w, cin, cout, k, sliding, padding)
+    (8, 8, 3, 16, 3, (1, 1), (0, 0, 0, 0)),
+    (9, 7, 4, 8, 3, (2, 2), (1, 1, 1, 1)),
+    (12, 12, 2, 8, 5, (2, 2), (2, 1, 0, 2)),   # asymmetric 4-tuple pad
+    (6, 6, 8, 32, 1, (1, 1), (0, 0, 0, 0)),    # 1x1
+]
+
+
+@pytest.mark.parametrize("geom", CONV_GEOMS)
+def test_pallas_conv_matches_oracle(geom):
+    h, w, cin, cout, k, sliding, padding = geom
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(3, h, w, cin)).astype(np.float32)
+    wts = rng.normal(size=(k, k, cin, cout)).astype(np.float32) * 0.1
+    b = rng.normal(size=(cout,)).astype(np.float32)
+    ref = conv_ops.forward_linear(np, x, wts, b, sliding, padding)
+    out = conv2d_im2col(jnp.asarray(x), jnp.asarray(wts), jnp.asarray(b),
+                        sliding, padding, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+    # and without bias
+    ref0 = conv_ops.forward_linear(np, x, wts, None, sliding, padding)
+    out0 = conv2d_im2col(jnp.asarray(x), jnp.asarray(wts), None,
+                         sliding, padding, interpret=True)
+    np.testing.assert_allclose(np.asarray(out0), ref0, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("use_abs", [False, True])
+def test_pallas_stochastic_pool_matches_oracle(use_abs):
+    """Injected-bits path vs ops.pooling.stochastic_forward with the SAME
+    uniforms: identical winners and values (inverse-CDF strict-compare
+    semantics)."""
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(2, 7, 5, 4)).astype(np.float32)
+    ky = kx = 3
+    sy = sx = 2
+    patch, valid, _ = pool_ops.patches(np, x, ky, kx, sy, sx, pad_value=0.0)
+    n, oh, ow, K, c = patch.shape
+    bits = rng.integers(0, 2 ** 32, size=(n * oh * ow, c), dtype=np.uint32)
+    # the kernel's 24-bit uniform mapping (Mosaic-compatible cast path)
+    u = ((bits >> 8).astype(np.float32) * 2.0 ** -24)
+    y_ref, off_ref = pool_ops.stochastic_forward(
+        np, x, ky, kx, sy, sx, u.reshape(n, oh, ow, c), use_abs, train=True)
+    vtile = np.broadcast_to(valid.reshape(1, oh * ow, K), (n, oh * ow, K))
+    y_pl, tap = stochastic_pool(
+        jnp.asarray(patch.reshape(n * oh * ow, K, c)),
+        jnp.asarray(vtile.reshape(n * oh * ow, K)), seed=0,
+        use_abs=use_abs, bits=jnp.asarray(bits), interpret=True)
+    np.testing.assert_allclose(np.asarray(y_pl).reshape(n, oh, ow, c),
+                               y_ref, rtol=1e-6)
+    off_pl = pool_ops.offsets_of(
+        np, np.asarray(tap).reshape(n, oh, ow, c), x.shape, ky, kx, sy, sx)
+    np.testing.assert_array_equal(off_pl, off_ref)
+
+
+def test_pallas_stochastic_pool_prng_branch_plumbing():
+    """Exercise the bits=None in-kernel-PRNG branch end to end under the
+    interpreter: the emulated TPU PRNG yields zero bits, so u == 0 and
+    the strict-compare inverse CDF must select tap 0 everywhere — which
+    pins the seed/SMEM spec, prng_seed/bitcast plumbing and the zero-mass
+    fallback in one go (real-hardware randomness is covered by the
+    selection test on TPU runs)."""
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(2, 6, 6, 4)).astype(np.float32)
+    patch, valid, _ = pool_ops.patches(np, x, 2, 2, 2, 2, pad_value=0.0)
+    n, oh, ow, K, c = patch.shape
+    vtile = np.broadcast_to(valid.reshape(1, oh * ow, K), (n, oh * ow, K))
+    from jax.experimental.pallas import tpu as pltpu
+
+    y, tap = stochastic_pool(
+        jnp.asarray(patch.reshape(n * oh * ow, K, c)),
+        jnp.asarray(vtile.reshape(n * oh * ow, K)), seed=3,
+        interpret=pltpu.InterpretParams())
+    np.testing.assert_array_equal(np.asarray(tap), 0)
+    np.testing.assert_allclose(np.asarray(y),
+                               patch.reshape(n * oh * ow, K, c)[:, 0, :],
+                               rtol=1e-6)
+
+
+def test_pallas_som_step_matches_oracle():
+    rng = np.random.default_rng(9)
+    B, D, sy, sx = 32, 6, 5, 4
+    x = rng.normal(size=(B, D)).astype(np.float32)
+    w = rng.normal(size=(sy * sx, D)).astype(np.float32)
+    coords = np.asarray(k_ops.grid_coords(np, sy, sx))
+    for bs in (B, 20):   # full batch + padded tail
+        mask = (np.arange(B) < bs) if bs < B else None
+        w_ref, idx_ref = k_ops.update(np, x, w, coords, 0.3, 1.5, mask)
+        w_pl, idx_pl = som_step(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(coords), 0.3, 1.5, bs,
+                                interpret=True)
+        np.testing.assert_allclose(np.asarray(w_pl), w_ref, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(idx_pl), idx_ref)
+
+
+def test_pallas_conv_unit_selection():
+    """root.common.engine.pallas routes Conv.xla_run through the im2col
+    kernel with identical outputs."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.conv import Conv
+
+    def run_once():
+        prng.seed_all(12)
+        w = Workflow(name="c")
+        conv = Conv(w, n_kernels=8, kx=3, ky=3, sliding=(2, 2),
+                    padding=(1, 1, 1, 1))
+        from znicz_tpu.core.memory import Array
+        conv.input = Array()
+        conv.input.mem = np.random.default_rng(5).normal(
+            size=(4, 9, 9, 3)).astype(np.float32)
+        conv.initialize(device=TPUDevice())
+        conv.xla_run()
+        return np.asarray(conv.output.map_read())
+
+    base = run_once()
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        pallas = run_once()
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    np.testing.assert_allclose(pallas, base, rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_kohonen_trainer_selection():
+    """SOM demo trains identically through the fused Pallas step."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.models import kohonen as km
+
+    def run_once():
+        prng.seed_all(21)
+        w = km.build(max_epochs=2, shape=(5, 5), n_train=200)
+        w.initialize(device=TPUDevice())
+        w.run()
+        return np.asarray(w.trainer.weights.map_read())
+
+    base = run_once()
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        pallas = run_once()
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    np.testing.assert_allclose(pallas, base, rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_stochastic_pooling_unit_selection():
+    """The stochastic pooling unit's Pallas path emits values from the
+    right windows with offsets consistent with the emitted values."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.pooling import StochasticPooling
+
+    prng.seed_all(33)
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        w = Workflow(name="sp")
+        unit = StochasticPooling(w, kx=2, ky=2, sliding=(2, 2))
+        unit.input = Array()
+        x = np.random.default_rng(6).normal(
+            size=(3, 6, 6, 4)).astype(np.float32)
+        unit.input.mem = x
+        unit.initialize(device=TPUDevice())
+        unit.xla_run()
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    y = np.asarray(unit.output.map_read())
+    off = np.asarray(unit.input_offset.map_read())
+    flat = x.reshape(3, -1, 4)
+    n, oh, ow, c = y.shape
+    for ni in range(n):
+        for ci in range(c):
+            picked = flat[ni, off[ni, :, :, ci].ravel(), ci]
+            np.testing.assert_allclose(picked, y[ni, :, :, ci].ravel(),
+                                       rtol=1e-6)

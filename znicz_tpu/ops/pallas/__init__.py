@@ -5,7 +5,9 @@ Policy: XLA-native lowerings are the default everywhere (XLA already fuses
 elementwise chains into matmuls); Pallas versions exist where the
 reference's fusion/PRNG semantics are the point — the fused SGD update
 (one HBM pass over weights+velocity), dropout with in-kernel counter PRNG,
-and LRN's sliding-window pair.  Each kernel has an ``interpret=`` switch
+LRN's sliding-window pair, the implicit-im2col GEMM conv, stochastic
+pooling with in-kernel PRNG, and the fused Kohonen
+distance+argmin+update step.  Each kernel has an ``interpret=`` switch
 so the CPU test mesh can pin it against the jnp oracle
 (tests/test_pallas_kernels.py); unit code selects via
 ``root.common.engine.pallas``.
@@ -14,3 +16,6 @@ so the CPU test mesh can pin it against the jnp oracle
 from znicz_tpu.ops.pallas.sgd import fused_sgd_update  # noqa: F401
 from znicz_tpu.ops.pallas.dropout import dropout_forward  # noqa: F401
 from znicz_tpu.ops.pallas.lrn import lrn_backward, lrn_forward  # noqa: F401
+from znicz_tpu.ops.pallas.conv import conv2d_im2col  # noqa: F401
+from znicz_tpu.ops.pallas.pooling import stochastic_pool  # noqa: F401
+from znicz_tpu.ops.pallas.kohonen import som_step  # noqa: F401

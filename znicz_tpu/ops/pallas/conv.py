@@ -1,0 +1,74 @@
+"""Implicit-im2col convolution as a Pallas kernel — the hand-written GEMM
+conv of SURVEY.md §8 step 3 ("hand-written kernel parity"), rebuilding the
+reference's conv/forward.{cl,cu} shared-memory im2col GEMM.
+
+One grid step per image: the padded input tile sits in VMEM and the
+kernel-window loop issues one MXU GEMM per (ky, kx) tap —
+``y[p, :] += x[p*s + tap, :] @ w[tap]`` — accumulating in f32.  The patch
+tensor the reference materializes in shared memory never exists: the
+window taps are strided VMEM slices (implicit im2col).
+
+Policy note (ops/pallas/__init__.py): XLA's native conv is the default
+everywhere; this kernel is the selectable parity path
+(``root.common.engine.pallas``) and the tier-1 cross-check target.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from znicz_tpu.ops.conv import normalize_geometry, out_size
+
+
+def _kernel(x_ref, w_ref, b_ref, y_ref, *, ky, kx, sy, sx, oh, ow):
+    x = x_ref[0]                                   # (hp, wp, cin)
+    cin = x.shape[-1]
+    cout = w_ref.shape[-1]
+    acc = jnp.zeros((oh * ow, cout), jnp.float32)
+    for iy in range(ky):
+        for ix in range(kx):
+            tap = jax.lax.slice(
+                x, (iy, ix, 0),
+                (iy + (oh - 1) * sy + 1, ix + (ow - 1) * sx + 1, cin),
+                (sy, sx, 1))                       # (oh, ow, cin)
+            acc += jnp.dot(tap.reshape(oh * ow, cin), w_ref[iy, ix],
+                           preferred_element_type=jnp.float32)
+    acc += b_ref[:]
+    y_ref[0] = acc.reshape(oh, ow, cout).astype(y_ref.dtype)
+
+
+def conv2d_im2col(x, weights, bias, sliding=(1, 1), padding=(0, 0, 0, 0),
+                  *, interpret: bool = False):
+    """NHWC x * HWIO weights (+ bias) — pre-activation conv, identical
+    geometry semantics to ops.conv.forward_linear."""
+    ky, kx = weights.shape[0], weights.shape[1]
+    ky, kx, sy, sx, pt, pb, pl_, pr = normalize_geometry(
+        kx, ky, sliding, padding)
+    n, h, w, cin = x.shape
+    oh = out_size(h, ky, sy, pt, pb)
+    ow = out_size(w, kx, sx, pl_, pr)
+    cout = weights.shape[3]
+    xpad = jnp.pad(x, ((0, 0), (pt, pb), (pl_, pr), (0, 0)))
+    hp, wp = xpad.shape[1], xpad.shape[2]
+    if bias is None:
+        bias = jnp.zeros((cout,), x.dtype)
+    kern = partial(_kernel, ky=ky, kx=kx, sy=sy, sx=sx, oh=oh, ow=ow)
+    return pl.pallas_call(
+        kern,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, cin), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, oh, ow, cout), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, oh, ow, cout), x.dtype),
+        interpret=interpret,
+    )(xpad, weights, bias)

@@ -109,10 +109,22 @@ class Conv(Forward):
         self.output.mem = out
 
     def xla_init(self) -> None:
-        act, sliding, padding = self.ACTIVATION, self.sliding, self.padding
+        from znicz_tpu.core.config import root
+        from znicz_tpu.ops import activations as act_ops
 
-        def fn(x, w, b):
-            return conv_ops.forward(jnp, x, w, b, sliding, padding, act)
+        act, sliding, padding = self.ACTIVATION, self.sliding, self.padding
+        if bool(root.common.engine.get("pallas", False)):
+            # hand-written implicit-im2col GEMM kernel (parity path)
+            from znicz_tpu.ops.pallas import conv2d_im2col
+            interp = bool(root.common.engine.get("pallas_interpret", False))
+
+            def fn(x, w, b):
+                v = conv2d_im2col(x, w, b, sliding, padding,
+                                  interpret=interp)
+                return act_ops.forward(jnp, act, v)
+        else:
+            def fn(x, w, b):
+                return conv_ops.forward(jnp, x, w, b, sliding, padding, act)
 
         self._xla_fn = jax.jit(fn)
 

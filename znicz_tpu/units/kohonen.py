@@ -101,12 +101,25 @@ class KohonenTrainer(KohonenBase):
         return (np.arange(n) < bs)
 
     def xla_init(self) -> None:
-        coords = jnp.asarray(self._coords_np)
+        from znicz_tpu.core.config import root
 
-        def fn(x, w, alpha, radius, bs):
-            mask = jnp.arange(x.shape[0]) < bs
-            new_w, idx = k_ops.update(jnp, x, w, coords, alpha, radius, mask)
-            return new_w, idx.astype(jnp.int32)
+        coords = jnp.asarray(self._coords_np)
+        if bool(root.common.engine.get("pallas", False)):
+            # fused distance+argmin+update kernel: weights read and
+            # written once per batch step
+            from znicz_tpu.ops.pallas import som_step
+            interp = bool(root.common.engine.get("pallas_interpret", False))
+
+            def fn(x, w, alpha, radius, bs):
+                new_w, idx = som_step(x, w, coords, alpha, radius, bs,
+                                      interpret=interp)
+                return new_w, idx.astype(jnp.int32)
+        else:
+            def fn(x, w, alpha, radius, bs):
+                mask = jnp.arange(x.shape[0]) < bs
+                new_w, idx = k_ops.update(jnp, x, w, coords, alpha, radius,
+                                          mask)
+                return new_w, idx.astype(jnp.int32)
 
         self._xla_fn = jax.jit(fn)
 

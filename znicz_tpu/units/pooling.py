@@ -177,6 +177,34 @@ class StochasticPooling(OffsetPooling):
             self.input_offset.mem = off
 
     def xla_init(self) -> None:
+        from znicz_tpu.core.config import root
+
+        self._pallas = bool(root.common.engine.get("pallas", False))
+        self._pallas_interp = bool(
+            root.common.engine.get("pallas_interpret", False))
+        if self._pallas:
+            # in-kernel-PRNG path: patches stream through the Pallas
+            # kernel, the uniform is drawn per output cell on device
+            from znicz_tpu.ops.pallas import stochastic_pool
+
+            ky, kx, sy, sx = self.ky, self.kx, self.sy, self.sx
+            use_abs, interp = self.USE_ABS, self._pallas_interp
+
+            def fn(x, seed, bits):
+                patch, valid, _ = pool_ops.patches(
+                    jnp, x, ky, kx, sy, sx, pad_value=0.0)
+                n, oh, ow, K, c = patch.shape
+                vtile = jnp.broadcast_to(valid.reshape(1, oh * ow, K),
+                                         (n, oh * ow, K))
+                y, tap = stochastic_pool(
+                    patch.reshape(n * oh * ow, K, c),
+                    vtile.reshape(n * oh * ow, K),
+                    seed, use_abs, bits=bits, interpret=interp)
+                idx = tap.reshape(n, oh, ow, c)
+                off = pool_ops.offsets_of(jnp, idx, x.shape, ky, kx, sy, sx)
+                return y.reshape(n, oh, ow, c), off
+
+            self._xla_pallas_fn = jax.jit(fn)
         self._xla_fn = jax.jit(
             lambda x, u, train: pool_ops.stochastic_forward(
                 jnp, x, self.ky, self.kx, self.sy, self.sx, u,
@@ -186,6 +214,20 @@ class StochasticPooling(OffsetPooling):
     def xla_run(self) -> None:
         self.input.unmap()
         train = not self.forward_mode
+        if train and self._pallas:
+            seed = int(prng.get().randint(0, 2 ** 31))
+            # the interpreter's emulated TPU PRNG yields zeros: inject
+            # framework-stream bits there; real TPU draws in-kernel
+            bits = None
+            if self._pallas_interp:
+                n, oh, ow, c = self.output.shape
+                bits = jnp.asarray(np.asarray(
+                    prng.get().randint(0, 2 ** 32, (n * oh * ow, c)),
+                    dtype=np.uint32))
+            y, off = self._xla_pallas_fn(self.input.devmem, seed, bits)
+            self.output.set_devmem(y)
+            self.input_offset.set_devmem(off)
+            return
         u = jax.random.uniform(prng.get().key(), self.output.shape) \
             if train else None
         y, off = self._xla_fn(self.input.devmem, u, train)
