@@ -160,6 +160,119 @@ def test_ga_evaluations_share_one_seed_and_private_stream(monkeypatch):
     assert before != after  # stream advanced, was not reset to the start
 
 
+def _staged_fc_step(n_steps=6, batch=40):
+    """Small fused FC workflow + device-staged train/valid batches."""
+    import jax.numpy as jnp
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    prng.seed_all(51)
+    w = build_fused(max_epochs=1, layers=(32,), minibatch_size=batch,
+                    n_train=240, n_valid=80, mesh=data_parallel_mesh(4))
+    w.initialize(device=TPUDevice())
+    rng = np.random.default_rng(2)
+    xs = jnp.asarray(rng.normal(size=(n_steps, batch, 28, 28)),
+                     jnp.float32)
+    # learnable rule so lr actually matters: label = quadrant sign pattern
+    ys = jnp.asarray(
+        (np.asarray(xs)[:, :, :14, :].sum((2, 3)) >
+         np.asarray(xs)[:, :, 14:, :].sum((2, 3))).astype(np.int32))
+    ms = jnp.ones((n_steps, batch), bool)
+    return w, xs, ys, ms
+
+
+def test_vmapped_population_matches_sequential_and_scales():
+    """SURVEY.md §3.4 hyperparameter parallelism: the population is a
+    batched axis.  Each vmapped individual's fitness equals the same
+    hyperparams trained sequentially, and scoring P=8 individuals in one
+    dispatch beats 8 sequential scans wall-clock."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.utils.genetics import make_population_evaluator
+
+    w, xs, ys, ms = _staged_fc_step()
+    step = w.step
+    ex, ey, em = xs[0], ys[0], ms[0]
+    evaluator = make_population_evaluator(step)
+    P = 8
+    lrs = np.linspace(0.0, 0.35, P).astype(np.float32)
+    base = step.hyper_params()
+    hyper_pop = jax.tree.map(
+        lambda v: jnp.broadcast_to(jnp.float32(v), (P,)), base)
+    for i in range(len(base)):
+        hyper_pop[i]["lr"] = jnp.asarray(lrs)
+        hyper_pop[i]["lr_b"] = jnp.asarray(lrs)
+
+    t0 = time.perf_counter()
+    fits = np.asarray(jax.device_get(evaluator(
+        hyper_pop, xs, ys, ms, ex, ey, em)))
+    t_vmap_cold = time.perf_counter() - t0
+    assert fits.shape == (P,)
+    # lr=0 learns nothing; a healthy lr must beat it
+    assert fits.min() < fits[0], fits
+
+    # parity: sequential per-individual scans give identical fitness
+    def run_sequential(i):
+        hyper_i = jax.tree.map(lambda v: v[i], hyper_pop)
+        # fresh copies: _train_fn donates its params/key arguments
+        params = jax.tree.map(jnp.copy, step._params)
+        key_i = jax.random.fold_in(step._key, i)
+        for k in range(xs.shape[0]):
+            params, key_i, _ = step._train_fn(
+                params, key_i, hyper_i, xs[k], ys[k], ms[k])
+        return int(jax.device_get(step._eval_fn(params, ex, ey, em))
+                   ["n_err"])
+
+    run_sequential(0)           # warm: compiles _train_fn/_eval_fn
+    seq = []
+    t_seq = 0.0
+    for i in (0, 3, 7):
+        t0 = time.perf_counter()
+        seq.append(run_sequential(i))
+        t_seq += time.perf_counter() - t0
+    assert seq == [int(f) for f in fits[[0, 3, 7]]], (seq, fits)
+
+    # scaling: one warmed batched dispatch for 8 beats 3 sequential runs
+    t0 = time.perf_counter()
+    jax.device_get(evaluator(hyper_pop, xs, ys, ms, ex, ey, em))
+    t_vmap = time.perf_counter() - t0
+    assert t_vmap < t_seq, (t_vmap, t_seq, t_vmap_cold)
+
+
+def test_ga_with_vmapped_evaluator_converges_to_good_lr():
+    """Genetics(evaluate_many=...) scores whole generations in one
+    compiled dispatch and still finds a working learning rate."""
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.utils.genetics import make_population_evaluator
+
+    w, xs, ys, ms = _staged_fc_step()
+    step = w.step
+    ex, ey, em = xs[0], ys[0], ms[0]
+    base = step.hyper_params()
+    evaluator = make_population_evaluator(step)
+
+    def evaluate_many(pop):
+        P = len(pop)
+        hyper_pop = jax.tree.map(
+            lambda v: jnp.broadcast_to(jnp.float32(v), (P,)), base)
+        lrs = jnp.asarray([ind["lr"] for ind in pop], jnp.float32)
+        for i in range(len(base)):
+            hyper_pop[i] = dict(hyper_pop[i], lr=lrs, lr_b=lrs)
+        return np.asarray(jax.device_get(evaluator(
+            hyper_pop, xs, ys, ms, ex, ey, em)))
+
+    prng.seed_all(6)
+    ga = Genetics(evaluate=None, evaluate_many=evaluate_many,
+                  tunes={"lr": Tune(0.0, 0.0, 0.4)},
+                  population_size=8, mutation_rate=0.5)
+    best, fit = ga.run(generations=4)
+    assert 0.0 < best["lr"] <= 0.4
+    assert fit <= evaluate_many([{"lr": 0.0}] * 1)[0], (best, fit)
+
+
 def test_ensemble_committee(tmp_path):
     ens = Ensemble(wine.build, n_members=3, base_seed=50, max_epochs=3,
                    n_train=60, n_valid=30, minibatch_size=10)

@@ -83,6 +83,63 @@ def test_snapshot_roundtrip_arrays(tmp_path):
         arrays["gd.0.gradient_weights"])
 
 
+@pytest.mark.parametrize("from_dev,to_dev", [(1, 8), (8, 1)])
+def test_elastic_resume_across_mesh_sizes(tmp_path, cpu_devices, from_dev,
+                                          to_dev):
+    """SURVEY.md §6.3: the framework's answer to the reference's slave
+    churn is snapshot -> restore onto a DIFFERENT mesh size -> continue.
+    Params are stored as host arrays and re-placed on the target mesh, so
+    the epoch metrics after resume must match an uninterrupted run (data
+    parallelism is the same math at any mesh size)."""
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    # uninterrupted 4-epoch reference run (1-device mesh)
+    prng.seed_all(77)
+    w_full = StandardWorkflow(
+        name="SnapTest", layers=LAYERS, loss_function="softmax",
+        loader_name="synthetic_classifier", loader_config=LOADER,
+        decision_config={"max_epochs": 4}, fused=True,
+        mesh=data_parallel_mesh(1))
+    w_full.initialize(device=TPUDevice())
+    w_full.run()
+    full_hist = w_full.decision.metrics_history
+
+    # full run on the source mesh, snapshotting every epoch; the epoch-2
+    # snapshot is the "job killed mid-run" state an elastic restart sees
+    prng.seed_all(77)
+    w_a = StandardWorkflow(
+        name="SnapTest", layers=LAYERS, loss_function="softmax",
+        loader_name="synthetic_classifier", loader_config=LOADER,
+        decision_config={"max_epochs": 4},
+        snapshotter_config={"directory": str(tmp_path), "prefix": "e",
+                            "only_improved": False, "keep_all": True},
+        fused=True, mesh=data_parallel_mesh(from_dev))
+    w_a.initialize(device=TPUDevice())
+    w_a.run()
+    snap = tmp_path / "e_2.npz"
+    assert snap.exists()
+
+    # resume onto the TARGET mesh size and finish (same seed: the
+    # synthetic dataset derives from it and is not part of the snapshot)
+    prng.seed_all(77)
+    w_b = StandardWorkflow(
+        name="SnapTest", layers=LAYERS, loss_function="softmax",
+        loader_name="synthetic_classifier", loader_config=LOADER,
+        decision_config={"max_epochs": 4}, fused=True,
+        mesh=data_parallel_mesh(to_dev))
+    w_b.initialize(device=TPUDevice())
+    restore_state(w_b, str(snap))
+    w_b.run()
+    resumed = w_b.decision.metrics_history
+    assert [h["metric_validation"] for h in resumed] == \
+        [h["metric_validation"] for h in full_hist], (resumed, full_hist)
+    w_full.stop()
+    w_b.stop()
+    np.testing.assert_allclose(w_b.forwards[0].weights.map_read(),
+                               w_full.forwards[0].weights.map_read(),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_snapshot_kohonen_workflow(tmp_path):
     """Regression (r1 advisor): KohonenTrainer sits in ``forwards`` but has
     no ``bias`` — collect_state/restore_state must tolerate non-standard

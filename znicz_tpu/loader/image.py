@@ -24,7 +24,8 @@ import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, register_loader
-from znicz_tpu.loader.normalization import normalizer_factory
+from znicz_tpu.loader.normalization import (normalizer_factory,
+                                             normalizer_from_state)
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
 
@@ -177,13 +178,19 @@ class FileImageLoader(Loader):
 
     def state_dict(self) -> dict:
         state = super().state_dict()
-        state["normalizer"] = self.normalizer
+        meta, arrays = self.normalizer.state_dict()
+        state["normalizer"] = {"meta": meta, "arrays": arrays}
         return state
 
     def load_state_dict(self, state: dict) -> None:
         super().load_state_dict(state)
         if "normalizer" in state:
-            self.normalizer = state["normalizer"]
+            self.normalizer = normalizer_from_state(
+                state["normalizer"]["meta"], state["normalizer"]["arrays"])
+            if getattr(self, "_raw_decoded", None) is not None:
+                # full-batch subclass pre-normalized at load time:
+                # re-apply the restored stats
+                self._decoded = self.normalizer.normalize(self._raw_decoded)
 
 
 @register_loader("full_batch_image")
@@ -194,9 +201,9 @@ class FullBatchImageLoader(FileImageLoader):
 
     def load_data(self) -> None:
         super().load_data()
-        decoded = np.stack([
+        self._raw_decoded = np.stack([
             _decode(p, self.sample_shape) for p in self._paths])
-        self._decoded = self.normalizer.normalize(decoded)
+        self._decoded = self.normalizer.normalize(self._raw_decoded)
 
     def fill_minibatch(self) -> None:
         indices = self.minibatch_indices.mem

@@ -22,7 +22,8 @@ import numpy as np
 from znicz_tpu.core.config import root
 from znicz_tpu.loader.base import register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader
-from znicz_tpu.loader.normalization import normalizer_factory
+from znicz_tpu.loader.normalization import (normalizer_factory,
+                                             normalizer_from_state)
 
 #: IDX dtype codes (the format's own table)
 _IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
@@ -38,7 +39,9 @@ FILES = {
 
 
 def write_idx(path: str, array: np.ndarray) -> None:
-    """Serialize ``array`` in IDX format (gzip if path ends with .gz)."""
+    """Serialize ``array`` in IDX format (gzip if path ends with .gz).
+    IDX payloads are big-endian; byte-swap multi-byte dtypes on write
+    (uint8 MNIST images are unaffected, int32/float32 tensors are not)."""
     array = np.ascontiguousarray(array)
     code = _IDX_CODES[array.dtype]
     opener = gzip.open if path.endswith(".gz") else open
@@ -46,7 +49,8 @@ def write_idx(path: str, array: np.ndarray) -> None:
         f.write(struct.pack(">BBBB", 0, 0, code, array.ndim))
         for dim in array.shape:
             f.write(struct.pack(">I", dim))
-        f.write(array.tobytes())
+        f.write(array.astype(array.dtype.newbyteorder(">"),
+                             copy=False).tobytes())
 
 
 def read_idx(path: str) -> np.ndarray:
@@ -173,21 +177,29 @@ class MnistLoader(FullBatchLoader):
         test_x, test_y = test_x[:n_valid], test_y[:n_valid]
         # fit on train only (reference: loader analyzes the train split)
         self.normalizer.analyze(train_x.astype(np.float32))
-        data = np.concatenate([test_x, train_x]).astype(np.float32)
+        # keep the raw bytes: a snapshot restore replaces the normalizer
+        # AFTER load_data ran, and must re-normalize the served data with
+        # the restored stats (weights were trained under them)
+        self._raw = np.concatenate([test_x, train_x]).astype(np.float32)
         # serve NHWC (28, 28, 1): conv stacks need the channel axis and
         # All2All flattens anything
-        data = self.normalizer.normalize(data)[..., None]
-        self.original_data.mem = data
+        self.original_data.mem = self.normalizer.normalize(self._raw)[..., None]
         self.original_labels.mem = np.concatenate(
             [test_y, train_y]).astype(np.int32)
         self.class_lengths = [0, len(test_x), len(train_x)]
 
     def state_dict(self) -> dict:
         state = super().state_dict()
-        state["normalizer"] = self.normalizer
+        meta, arrays = self.normalizer.state_dict()
+        state["normalizer"] = {"meta": meta, "arrays": arrays}
         return state
 
     def load_state_dict(self, state: dict) -> None:
         super().load_state_dict(state)
         if "normalizer" in state:
-            self.normalizer = state["normalizer"]
+            self.normalizer = normalizer_from_state(
+                state["normalizer"]["meta"], state["normalizer"]["arrays"])
+            if getattr(self, "_raw", None) is not None:
+                self.original_data.map_invalidate()
+                self.original_data.mem = \
+                    self.normalizer.normalize(self._raw)[..., None]

@@ -77,6 +77,46 @@ class NormalizerBase:
     def _reverse(self, data: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    # -- snapshot support ---------------------------------------------------
+    def state_dict(self) -> tuple[dict, dict]:
+        """-> (meta, arrays): JSON-able metadata + numpy fit state, split
+        so the snapshotter stores arrays in the .npz payload and meta in
+        the JSON header (the reference pickles the whole object; the
+        array-based snapshot format cannot)."""
+        meta: dict = {"type": self.NAME}
+        arrays: dict = {}
+        for k, v in vars(self).items():
+            if isinstance(v, np.ndarray):
+                arrays[k] = v
+            elif isinstance(v, NormalizerBase):
+                sub_meta, sub_arrays = v.state_dict()
+                meta[f"sub:{k}"] = sub_meta
+                arrays.update({f"{k}.{sk}": sv
+                               for sk, sv in sub_arrays.items()})
+            elif isinstance(v, tuple):
+                meta[f"attr:{k}"] = list(v)
+            else:
+                meta[f"attr:{k}"] = v
+        return meta, arrays
+
+
+def normalizer_from_state(meta: dict, arrays: dict) -> "NormalizerBase":
+    """Rebuild a fitted normalizer from :meth:`NormalizerBase.state_dict`
+    output."""
+    norm = normalizer_factory(meta["type"])
+    for key, v in meta.items():
+        if key.startswith("attr:"):
+            setattr(norm, key[5:], tuple(v) if isinstance(v, list) else v)
+        elif key.startswith("sub:"):
+            name = key[4:]
+            sub_arrays = {k[len(name) + 1:]: a for k, a in arrays.items()
+                          if k.startswith(name + ".")}
+            setattr(norm, name, normalizer_from_state(v, sub_arrays))
+    for k, a in arrays.items():
+        if "." not in k:
+            setattr(norm, k, np.asarray(a))
+    return norm
+
 
 @register_normalizer("none")
 class NoneNormalizer(NormalizerBase):

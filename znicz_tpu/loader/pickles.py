@@ -19,7 +19,8 @@ import numpy as np
 from znicz_tpu.core.config import root
 from znicz_tpu.loader.base import register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader
-from znicz_tpu.loader.normalization import normalizer_factory
+from znicz_tpu.loader.normalization import (normalizer_factory,
+                                             normalizer_from_state)
 
 TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
 VALID_FILE = "test_batch"
@@ -115,18 +116,25 @@ class PicklesImageLoader(FullBatchLoader):
         if self.n_valid:
             valid_x, valid_y = valid_x[:self.n_valid], valid_y[:self.n_valid]
         self.normalizer.analyze(train_x)
-        data = np.concatenate([valid_x, train_x])
-        self.original_data.mem = self.normalizer.normalize(data)
+        # raw kept: a snapshot restore swaps the normalizer in afterwards
+        # and must re-normalize with the restored stats
+        self._raw = np.concatenate([valid_x, train_x])
+        self.original_data.mem = self.normalizer.normalize(self._raw)
         self.original_labels.mem = np.concatenate(
             [valid_y, train_y]).astype(np.int32)
         self.class_lengths = [0, len(valid_x), len(train_x)]
 
     def state_dict(self) -> dict:
         state = super().state_dict()
-        state["normalizer"] = self.normalizer
+        meta, arrays = self.normalizer.state_dict()
+        state["normalizer"] = {"meta": meta, "arrays": arrays}
         return state
 
     def load_state_dict(self, state: dict) -> None:
         super().load_state_dict(state)
         if "normalizer" in state:
-            self.normalizer = state["normalizer"]
+            self.normalizer = normalizer_from_state(
+                state["normalizer"]["meta"], state["normalizer"]["arrays"])
+            if getattr(self, "_raw", None) is not None:
+                self.original_data.map_invalidate()
+                self.original_data.mem = self.normalizer.normalize(self._raw)

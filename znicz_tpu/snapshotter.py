@@ -59,6 +59,12 @@ def collect_state(workflow) -> tuple[dict, dict]:
     loader_state = workflow.loader.state_dict()
     for cls, order in loader_state.pop("shuffled").items():
         arrays[f"loader.shuffled.{cls}"] = np.asarray(order)
+    # fitted normalizers split into JSON meta + npz arrays (file loaders)
+    norm_state = loader_state.pop("normalizer", None)
+    if norm_state is not None:
+        for k, v in norm_state["arrays"].items():
+            arrays[f"loader.normalizer.{k}"] = np.asarray(v)
+        loader_state["normalizer_meta"] = norm_state["meta"]
     meta = {
         "format_version": FORMAT_VERSION,
         "workflow_name": workflow.name,
@@ -106,6 +112,13 @@ def restore_state(workflow, path: str) -> dict:
     loader_state["shuffled"] = {
         int(k.rsplit(".", 1)[1]): v for k, v in arrays.items()
         if k.startswith("loader.shuffled.")}
+    norm_meta = loader_state.pop("normalizer_meta", None)
+    if norm_meta is not None:
+        prefix = "loader.normalizer."
+        loader_state["normalizer"] = {
+            "meta": norm_meta,
+            "arrays": {k[len(prefix):]: v for k, v in arrays.items()
+                       if k.startswith(prefix)}}
     workflow.loader.load_state_dict(loader_state)
     workflow.decision.load_state_dict(meta["decision"])
     prng.load_state_dict(meta["prng"])

@@ -6,9 +6,17 @@ define the search space; each individual is a {dotted_path: value}
 assignment over the global ``root`` tree; fitness is the Decision's best
 validation metric of a complete (usually shrunk) training run.  Selection
 is top-half elitist, crossover uniform per-gene, mutation gaussian within
-the Tune range — the reference's GA shape (veles/genetics/core.py)
-without the distributed-slave evaluation plane (runs are sequential here;
-the vmap-over-configs path is the planned TPU upgrade, SURVEY.md §3.4).
+the Tune range — the reference's GA shape (veles/genetics/core.py).
+
+The reference parallelizes evaluation by farming individuals to ZeroMQ
+slaves; the TPU rebuild turns the population into a BATCHED AXIS instead:
+:func:`make_population_evaluator` builds a scorer that trains every individual simultaneously
+by ``jax.vmap``-ing the fused train step over a population-stacked
+hyperparameter pytree (SURVEY.md §3.4 "hyperparameter parallelism").
+Pass it to ``Genetics(evaluate_many=...)`` to score whole generations in
+one compiled dispatch.  The generic CLI ``--optimize`` path stays
+sequential — arbitrary Tune paths may change shapes (layer sizes), which
+no vmap can batch.
 """
 
 from __future__ import annotations
@@ -21,15 +29,73 @@ from znicz_tpu.core.config import (root, set_by_path, walk_tunes)
 from znicz_tpu.core.logger import Logger
 
 
+def make_population_evaluator(step):
+    """Build a reusable batched fitness scorer over ``step``.
+
+    The returned callable
+    ``evaluate(hyper_pop, train_xs, train_ys, train_ms, vx, vy, vm)``
+    scores a whole population in ONE compiled dispatch: ``hyper_pop`` is
+    a pytree shaped like ``step.hyper_params()`` whose every leaf carries
+    a leading population axis P; each individual trains its own clone of
+    the step's current params through a ``lax.scan`` over the staged
+    train minibatches, then scores validation errors — all P training
+    runs ride the same program as one batched dimension (the MXU sees
+    P-wide batched GEMMs; the reference needed P slave processes).
+    Returns the (P,) validation-error vector.  Compiled once per
+    (P, shapes) signature and cached across generations.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as PSpec
+
+    try:                               # jax >= 0.8
+        from jax import shard_map
+    except ImportError:                # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    def local(params, key, hyper_pop, xs, ys, ms, ex, ey, em):
+        n_pop = jax.tree.leaves(hyper_pop)[0].shape[0]
+
+        def one(hyper, k):
+            def body(carry, inp):
+                p, k2 = carry
+                p, k2, _ = step._local_train(p, k2, hyper, *inp)
+                return (p, k2), None
+            (p, _), _ = jax.lax.scan(body, (params, k), (xs, ys, ms))
+            return step._local_eval(p, ex, ey, em)["n_err"]
+
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(n_pop))
+        return jax.vmap(one)(hyper_pop, keys)
+
+    rep, sh = PSpec(), PSpec("data")
+    shs = PSpec(None, "data")
+    fn = jax.jit(shard_map(
+        local, mesh=step.mesh,
+        in_specs=(rep, rep, rep, shs, shs, shs, sh, sh, sh),
+        out_specs=rep))
+
+    def evaluate(hyper_pop, train_xs, train_ys, train_ms,
+                 valid_x, valid_y, valid_m):
+        return fn(step._params, step._key, hyper_pop,
+                  train_xs, train_ys, train_ms, valid_x, valid_y, valid_m)
+
+    return evaluate
+
+
 class Genetics(Logger):
     """GA driver over Tune leaves (reference: veles/genetics)."""
 
     def __init__(self, evaluate: Callable[[dict], float],
                  tunes: Optional[dict] = None,
                  population_size: int = 8, elite: float = 0.5,
-                 mutation_rate: float = 0.3, seed: int = 0xA11E1E) -> None:
+                 mutation_rate: float = 0.3, seed: int = 0xA11E1E,
+                 evaluate_many: Optional[Callable] = None) -> None:
         super().__init__()
         self.evaluate = evaluate
+        #: optional batched scorer: list[individual] -> list[float] in one
+        #: call (the vmapped TPU path — make_population_evaluator)
+        self.evaluate_many = evaluate_many
         self.tunes = tunes if tunes is not None else dict(walk_tunes(root))
         if not self.tunes:
             raise ValueError("no Tune() leaves found in root — nothing to "
@@ -77,9 +143,12 @@ class Genetics(Logger):
                 for _ in range(self.population_size - 1)]
         best, best_fit = None, float("inf")
         for g in range(generations):
+            if self.evaluate_many is not None:
+                fits = [float(f) for f in self.evaluate_many(pop)]
+            else:
+                fits = [float(self.evaluate(ind)) for ind in pop]
             scored = []
-            for ind in pop:
-                fit = float(self.evaluate(ind))
+            for fit, ind in zip(fits, pop):
                 scored.append((fit, ind))
                 if fit < best_fit:
                     best, best_fit = dict(ind), fit
