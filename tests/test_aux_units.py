@@ -183,3 +183,88 @@ def test_nn_rollback_restores_and_cuts_lr():
     assert rb.rollback_count == 1
     np.testing.assert_array_equal(w.forwards[0].weights.map_read(), good)
     assert w.gds[0].learning_rate == pytest.approx(0.05)
+
+
+# -- diversity diagnostic (SURVEY §3.1) --------------------------------------
+
+def test_diversity_groups_duplicate_kernels():
+    from znicz_tpu.units.diversity import (Diversity, get_similar_kernels,
+                                           kernels_of, similarity_matrix)
+
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(6, 20)).astype(np.float32)
+    w[3] = w[0] * 2.0 + 0.1          # correlated with kernel 0
+    w[5] = w[2] * 0.5                # correlated with kernel 2
+    sim = similarity_matrix(w)
+    np.testing.assert_allclose(np.diag(sim), 1.0, rtol=1e-5)
+    groups = get_similar_kernels(w, threshold=0.95)
+    assert [0, 3] in groups and [2, 5] in groups
+    assert get_similar_kernels(rng.normal(size=(6, 20)), 0.95) == []
+
+
+def test_diversity_unit_reports_on_workflow():
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.all2all import All2All
+    from znicz_tpu.units.diversity import Diversity
+    from znicz_tpu.core.memory import Array
+
+    prng.seed_all(8)
+    w = Workflow(name="d")
+    fc = All2All(w, output_sample_shape=8)
+    fc.input = Array()
+    fc.input.mem = np.zeros((4, 10), np.float32)
+    fc.initialize(device=NumpyDevice())
+    # plant duplicates: two output kernels share a column direction
+    wm = fc.weights.map_read().copy()
+    wm[:, 5] = wm[:, 1] * 3.0
+    fc.weights.map_invalidate()
+    fc.weights.mem = wm
+    unit = Diversity(w, threshold=0.95).link_forwards([fc])
+    unit.run()
+    assert 0 in unit.report
+    assert [1, 5] in unit.report[0]
+
+
+# -- publishing (SURVEY §3.3) ------------------------------------------------
+
+def test_publisher_markdown_and_html(tmp_path):
+    from znicz_tpu.models import wine
+    from znicz_tpu.utils.publishing import Publisher
+
+    prng.seed_all(3)
+    w = wine.build(max_epochs=2, n_train=60, n_valid=30, minibatch_size=10)
+    w.initialize(device=TPUDevice())
+    w.run()
+    md = Publisher(backend="markdown",
+                   directory=str(tmp_path)).publish(w)
+    text = open(md).read()
+    assert "training report" in text
+    assert "metric_validation" in text
+    assert "Timing" in text and "Config" in text
+    assert str(int(w.decision.best_metric)) in text
+    ht = Publisher(backend="html", directory=str(tmp_path)).publish(w)
+    html_text = open(ht).read()
+    assert html_text.startswith("<!doctype html>")
+    assert "metric_validation" in html_text
+
+
+def test_cli_publish_flag(tmp_path, monkeypatch):
+    import textwrap
+    from znicz_tpu.__main__ import main as cli_main
+    from znicz_tpu.core.config import root
+
+    wf = tmp_path / "wf.py"
+    wf.write_text(textwrap.dedent("""
+        from znicz_tpu.models import wine
+        def run(load, main):
+            load(wine.build, max_epochs=1, n_train=60, n_valid=30,
+                 minibatch_size=10)
+            main()
+        """))
+    monkeypatch.chdir(tmp_path)
+    rc = cli_main([str(wf), "--publish", "markdown", "-d", "tpu",
+                   "--random-seed", "4"])
+    assert rc == 0
+    assert (tmp_path / "winedemo_report.md").exists() or \
+        any(p.suffix == ".md" for p in tmp_path.iterdir()), \
+        list(tmp_path.iterdir())

@@ -222,14 +222,16 @@ def test_restored_normalizer_renormalizes_fullbatch_data(tmp_path):
     d = str(tmp_path / "mnist")
     loader = mnist_mod.MnistLoader(Workflow(name="a"), data_dir=d,
                                    n_train=60, n_valid=20,
-                                   minibatch_size=10, synth_sizes=(80, 30))
+                                   minibatch_size=10, synth_sizes=(80, 30),
+                                   normalization_type="mean_disp")
     loader.load_data()
     state = loader.state_dict()
 
-    # a loader over a DIFFERENT subset fits different stats...
+    # a loader over a DIFFERENT subset fits different per-pixel stats...
     loader2 = mnist_mod.MnistLoader(Workflow(name="b"), data_dir=d,
                                     n_train=30, n_valid=20,
-                                    minibatch_size=10, synth_sizes=(80, 30))
+                                    minibatch_size=10, synth_sizes=(80, 30),
+                                    normalization_type="mean_disp")
     loader2.load_data()
     before = loader2.original_data.mem.copy()
     # ...until the snapshot normalizer is restored: data re-normalized
@@ -239,10 +241,13 @@ def test_restored_normalizer_renormalizes_fullbatch_data(tmp_path):
                              **{k: v for k, v in state.items()
                                 if k not in ("normalizer", "shuffled")}})
     after = loader2.original_data.mem
-    ref = loader.normalizer.normalize(loader2._raw)[..., None]
+    test_x, _ty, train_x, _y = loader2._load_raw()
+    raw = np.concatenate([test_x, train_x]).astype(np.float32)
+    ref = loader.normalizer.normalize(raw)[..., None]
     np.testing.assert_allclose(after, ref, rtol=1e-6)
-    assert loader2.normalizer.vmin == loader.normalizer.vmin
-    del before
+    np.testing.assert_array_equal(loader2.normalizer.mean,
+                                  loader.normalizer.mean)
+    assert not np.allclose(after, before)   # restore actually re-scaled
 
 
 def test_alexnet_file_image_epoch(tmp_path):

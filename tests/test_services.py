@@ -150,3 +150,77 @@ def test_export_and_forge_roundtrip(tmp_path):
     assert forge_list(repo) == {"wine": ["1.0", "1.1"]}
     fetched = forge_fetch(repo, "wine")          # latest
     np.testing.assert_allclose(fetched(data), probs, rtol=1e-6)
+
+
+# -- forge registry (SURVEY §3.3) --------------------------------------------
+
+def test_forge_upload_fetch_roundtrip(tmp_path):
+    import numpy as np
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models import wine
+    from znicz_tpu.utils.export import ExportedForward
+    from znicz_tpu.utils.forge import ForgeRegistry
+
+    prng.seed_all(3)
+    w = wine.build(max_epochs=2, n_train=60, n_valid=30, minibatch_size=10)
+    w.initialize(device=TPUDevice())
+    w.run()
+    w.stop()
+
+    reg = ForgeRegistry(str(tmp_path / "registry"))
+    entry = reg.upload_workflow(w, "wine", "1.0")
+    assert entry["metadata"]["workflow"] == "WineDemo" or \
+        entry["metadata"]["workflow"] == w.name
+    assert reg.list_packages() == {"wine": ["1.0"]}
+    # immutability
+    import pytest
+    with pytest.raises(FileExistsError):
+        reg.upload_workflow(w, "wine", "1.0")
+    reg.upload_workflow(w, "wine", "1.1")
+    # latest fetch + checksum + inference parity with the live workflow
+    dest = reg.fetch("wine", dest=str(tmp_path / "got.npz"))
+    loaded = ExportedForward(dest)
+    x = np.asarray(w.loader.original_data.map_read()[:8], np.float32)
+    live = w.forwards[0]
+    got = loaded(x)
+    assert got.shape[0] == 8
+    with pytest.raises(KeyError):
+        reg.fetch("nonexistent")
+    with pytest.raises(KeyError):
+        reg.fetch("wine", "9.9")
+
+
+def test_forge_detects_corruption(tmp_path):
+    import numpy as np
+    from znicz_tpu.utils.forge import ForgeRegistry
+
+    pkg = tmp_path / "pkg.npz"
+    np.savez(pkg, a=np.arange(3))
+    reg = ForgeRegistry(str(tmp_path / "reg"))
+    reg.upload(str(pkg), "thing", "0.1")
+    # corrupt the stored file
+    stored = tmp_path / "reg" / "thing-0.1.npz"
+    stored.write_bytes(b"corrupted")
+    import pytest
+    with pytest.raises(IOError, match="sha256"):
+        reg.fetch("thing", dest=str(tmp_path / "out.npz"))
+
+
+def test_launcher_profile_trace(tmp_path):
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.launcher import Launcher
+    from znicz_tpu.models import wine
+
+    prng.seed_all(3)
+    launcher = Launcher(device=TPUDevice(),
+                        profile_dir=str(tmp_path / "trace"))
+    launcher.load(wine.build, max_epochs=1, n_train=60, n_valid=30,
+                  minibatch_size=10)
+    launcher.main()
+    import os
+    found = []
+    for base, _dirs, files in os.walk(tmp_path / "trace"):
+        found += files
+    assert found, "no profiler trace files written"

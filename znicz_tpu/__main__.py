@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="config override, applied after config files")
     p.add_argument("--optimize", type=int, default=None, metavar="GENS",
                    help="genetic hyperparameter search over Tune() leaves")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the run to DIR")
+    p.add_argument("--publish", default=None, metavar="BACKEND",
+                   choices=("markdown", "html"),
+                   help="write a post-training report (reference: "
+                        "veles/publishing)")
     # multi-host SPMD (replaces the reference's -l/-m master/slave flags)
     p.add_argument("--coordinator", default=None,
                    help="host:port of process 0 (multi-host SPMD)")
@@ -87,13 +93,21 @@ def main(argv=None) -> int:
         set_by_path(root, path, _parse_value(value))
     module = load_workflow_module(args.workflow)
     launcher = Launcher(device=make_device(args.device),
-                        snapshot=args.snapshot, stealth=args.stealth)
+                        snapshot=args.snapshot, stealth=args.stealth,
+                        profile_dir=args.profile)
     if args.optimize is not None:
+        if args.publish is not None:
+            print("--publish cannot be combined with --optimize "
+                  "(GA evaluation runs are throwaway)", file=sys.stderr)
+            return 2
         from znicz_tpu.utils.genetics import optimize
         best = optimize(module, launcher, generations=args.optimize)
         print(f"best config: {best}")
         return 0
     module.run(launcher.load, launcher.main)
+    if args.publish is not None and launcher.workflow is not None:
+        from znicz_tpu.utils.publishing import Publisher
+        Publisher(backend=args.publish).publish(launcher.workflow)
     return 0
 
 

@@ -33,12 +33,17 @@ class Launcher(Logger):
 
     def __init__(self, device: Optional[Device] = None,
                  snapshot: Optional[str] = None,
-                 stealth: bool = False) -> None:
+                 stealth: bool = False,
+                 profile_dir: Optional[str] = None) -> None:
         super().__init__()
         self.device = device
         self.snapshot = snapshot
         #: stealth: suppress side services (plotters/web) — reference -s
         self.stealth = stealth
+        #: when set, the run is wrapped in ``jax.profiler.trace`` and the
+        #: trace lands here (open with TensorBoard / xprof — SURVEY §6.1,
+        #: the TPU-native upgrade over the reference's wall-clock table)
+        self.profile_dir = profile_dir
         self.workflow = None
         self._interrupted = False
 
@@ -61,9 +66,22 @@ class Launcher(Logger):
             self.info(f"resumed from {self.snapshot} "
                       f"(epoch {meta['loader']['epoch_number']})")
         prev = signal.signal(signal.SIGINT, self._on_sigint)
+        profiling = False
+        if self.profile_dir:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            profiling = True
         try:
             self.workflow.run()
         finally:
+            if profiling:
+                # a failing trace flush must not skip the rest of cleanup
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                    self.info(f"profiler trace -> {self.profile_dir}")
+                except Exception as exc:  # noqa: BLE001
+                    self.warning(f"profiler trace failed: {exc!r}")
             signal.signal(signal.SIGINT, prev)
             self.workflow.stop()
         self.info("timing:\n" + self.workflow.timing_table())

@@ -24,8 +24,8 @@ import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, register_loader
-from znicz_tpu.loader.normalization import (normalizer_factory,
-                                             normalizer_from_state)
+from znicz_tpu.loader.normalization import (NormalizerStateMixin,
+                                             normalizer_factory)
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
 
@@ -94,7 +94,7 @@ def synthesize_image_dataset(data_dir: str, n_classes: int = 8,
 
 
 @register_loader("file_image")
-class FileImageLoader(Loader):
+class FileImageLoader(NormalizerStateMixin, Loader):
     """Streaming directory-per-class image loader.
 
     ``valid_fraction`` of each class (deterministic seeded split) serves as
@@ -176,22 +176,6 @@ class FileImageLoader(Loader):
         self.minibatch_data.mem = data
         self.minibatch_labels.mem = labels
 
-    def state_dict(self) -> dict:
-        state = super().state_dict()
-        meta, arrays = self.normalizer.state_dict()
-        state["normalizer"] = {"meta": meta, "arrays": arrays}
-        return state
-
-    def load_state_dict(self, state: dict) -> None:
-        super().load_state_dict(state)
-        if "normalizer" in state:
-            self.normalizer = normalizer_from_state(
-                state["normalizer"]["meta"], state["normalizer"]["arrays"])
-            if getattr(self, "_raw_decoded", None) is not None:
-                # full-batch subclass pre-normalized at load time:
-                # re-apply the restored stats
-                self._decoded = self.normalizer.normalize(self._raw_decoded)
-
 
 @register_loader("full_batch_image")
 class FullBatchImageLoader(FileImageLoader):
@@ -201,9 +185,14 @@ class FullBatchImageLoader(FileImageLoader):
 
     def load_data(self) -> None:
         super().load_data()
-        self._raw_decoded = np.stack([
-            _decode(p, self.sample_shape) for p in self._paths])
-        self._decoded = self.normalizer.normalize(self._raw_decoded)
+        self._decoded = self.normalizer.normalize(np.stack([
+            _decode(p, self.sample_shape) for p in self._paths]))
+
+    def _renormalize_served_data(self) -> None:
+        # restore swapped the normalizer in: re-decode from disk (the
+        # tree is still there) instead of keeping a raw in-RAM copy
+        self._decoded = self.normalizer.normalize(np.stack([
+            _decode(p, self.sample_shape) for p in self._paths]))
 
     def fill_minibatch(self) -> None:
         indices = self.minibatch_indices.mem

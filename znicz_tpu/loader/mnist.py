@@ -22,8 +22,8 @@ import numpy as np
 from znicz_tpu.core.config import root
 from znicz_tpu.loader.base import register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader
-from znicz_tpu.loader.normalization import (normalizer_factory,
-                                             normalizer_from_state)
+from znicz_tpu.loader.normalization import (NormalizerStateMixin,
+                                             normalizer_factory)
 
 #: IDX dtype codes (the format's own table)
 _IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
@@ -126,7 +126,7 @@ def synthesize_mnist(directory: str, n_train: int = 6000,
 
 
 @register_loader("mnist")
-class MnistLoader(FullBatchLoader):
+class MnistLoader(NormalizerStateMixin, FullBatchLoader):
     """IDX-file MNIST with fitted normalization.
 
     ``n_train`` / ``n_valid`` subset the files (None = all); the MNIST
@@ -164,7 +164,11 @@ class MnistLoader(FullBatchLoader):
         self.info(f"synthesizing MNIST-format dataset in {self.data_dir}")
         synthesize_mnist(self.data_dir, *self.synth_sizes)
 
-    def load_data(self) -> None:
+    def _load_raw(self):
+        """(test_x, test_y, train_x, train_y) straight from the IDX
+        files, subset applied — shared by load_data and the restore
+        re-normalization (which re-reads instead of holding a second
+        in-RAM copy of the dataset)."""
         self._ensure_files()
         d = self.data_dir
         train_x = read_idx(os.path.join(d, FILES["train_images"]))
@@ -173,33 +177,25 @@ class MnistLoader(FullBatchLoader):
         test_y = read_idx(os.path.join(d, FILES["test_labels"]))
         n_train = self.n_train or len(train_x)
         n_valid = self.n_valid if self.n_valid is not None else len(test_x)
-        train_x, train_y = train_x[:n_train], train_y[:n_train]
-        test_x, test_y = test_x[:n_valid], test_y[:n_valid]
+        return (test_x[:n_valid], test_y[:n_valid],
+                train_x[:n_train], train_y[:n_train])
+
+    def load_data(self) -> None:
+        test_x, test_y, train_x, train_y = self._load_raw()
         # fit on train only (reference: loader analyzes the train split)
         self.normalizer.analyze(train_x.astype(np.float32))
-        # keep the raw bytes: a snapshot restore replaces the normalizer
-        # AFTER load_data ran, and must re-normalize the served data with
-        # the restored stats (weights were trained under them)
-        self._raw = np.concatenate([test_x, train_x]).astype(np.float32)
+        raw = np.concatenate([test_x, train_x]).astype(np.float32)
         # serve NHWC (28, 28, 1): conv stacks need the channel axis and
         # All2All flattens anything
-        self.original_data.mem = self.normalizer.normalize(self._raw)[..., None]
+        self.original_data.mem = self.normalizer.normalize(raw)[..., None]
         self.original_labels.mem = np.concatenate(
             [test_y, train_y]).astype(np.int32)
         self.class_lengths = [0, len(test_x), len(train_x)]
 
-    def state_dict(self) -> dict:
-        state = super().state_dict()
-        meta, arrays = self.normalizer.state_dict()
-        state["normalizer"] = {"meta": meta, "arrays": arrays}
-        return state
-
-    def load_state_dict(self, state: dict) -> None:
-        super().load_state_dict(state)
-        if "normalizer" in state:
-            self.normalizer = normalizer_from_state(
-                state["normalizer"]["meta"], state["normalizer"]["arrays"])
-            if getattr(self, "_raw", None) is not None:
-                self.original_data.map_invalidate()
-                self.original_data.mem = \
-                    self.normalizer.normalize(self._raw)[..., None]
+    def _renormalize_served_data(self) -> None:
+        # a snapshot restore swapped the normalizer in AFTER load_data:
+        # re-read the raw files and re-normalize with the restored stats
+        test_x, _ty, train_x, _y = self._load_raw()
+        raw = np.concatenate([test_x, train_x]).astype(np.float32)
+        self.original_data.map_invalidate()
+        self.original_data.mem = self.normalizer.normalize(raw)[..., None]

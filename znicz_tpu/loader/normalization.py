@@ -100,6 +100,33 @@ class NormalizerBase:
         return meta, arrays
 
 
+class NormalizerStateMixin:
+    """state_dict/load_state_dict plumbing shared by every loader that
+    owns a fitted ``self.normalizer`` (mix in BEFORE the loader base).
+
+    On restore, :meth:`_renormalize_served_data` re-derives any data the
+    loader pre-normalized at load time — full-batch loaders re-read the
+    raw bytes from disk rather than holding a second in-RAM copy of the
+    dataset for the rare restore path."""
+
+    def state_dict(self) -> dict:
+        state = super().state_dict()
+        meta, arrays = self.normalizer.state_dict()
+        state["normalizer"] = {"meta": meta, "arrays": arrays}
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        super().load_state_dict(state)
+        if "normalizer" in state:
+            self.normalizer = normalizer_from_state(
+                state["normalizer"]["meta"], state["normalizer"]["arrays"])
+            self._renormalize_served_data()
+
+    def _renormalize_served_data(self) -> None:
+        """Re-apply the (restored) normalizer to pre-normalized data;
+        streaming loaders that normalize per minibatch need nothing."""
+
+
 def normalizer_from_state(meta: dict, arrays: dict) -> "NormalizerBase":
     """Rebuild a fitted normalizer from :meth:`NormalizerBase.state_dict`
     output."""

@@ -19,8 +19,8 @@ import numpy as np
 from znicz_tpu.core.config import root
 from znicz_tpu.loader.base import register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader
-from znicz_tpu.loader.normalization import (normalizer_factory,
-                                             normalizer_from_state)
+from znicz_tpu.loader.normalization import (NormalizerStateMixin,
+                                             normalizer_factory)
 
 TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
 VALID_FILE = "test_batch"
@@ -71,7 +71,7 @@ def synthesize_cifar(data_dir: str, shape=(32, 32, 3),
 
 
 @register_loader("pickles_image")
-class PicklesImageLoader(FullBatchLoader):
+class PicklesImageLoader(NormalizerStateMixin, FullBatchLoader):
     """CIFAR-format pickled-batch full-batch loader."""
 
     def __init__(self, workflow=None, data_dir: str | None = None,
@@ -103,7 +103,9 @@ class PicklesImageLoader(FullBatchLoader):
         synthesize_cifar(self.data_dir, shape=self.sample_shape,
                          **self.synth_config)
 
-    def load_data(self) -> None:
+    def _load_raw(self):
+        """(valid_x, valid_y, train_x, train_y) from the pickle batches,
+        subsets applied; shared by load_data and restore."""
         self._ensure_files()
         parts = [_read_batch(os.path.join(self.data_dir, n),
                              self.sample_shape) for n in TRAIN_FILES]
@@ -115,26 +117,19 @@ class PicklesImageLoader(FullBatchLoader):
             train_x, train_y = train_x[:self.n_train], train_y[:self.n_train]
         if self.n_valid:
             valid_x, valid_y = valid_x[:self.n_valid], valid_y[:self.n_valid]
+        return valid_x, valid_y, train_x, train_y
+
+    def load_data(self) -> None:
+        valid_x, valid_y, train_x, train_y = self._load_raw()
         self.normalizer.analyze(train_x)
-        # raw kept: a snapshot restore swaps the normalizer in afterwards
-        # and must re-normalize with the restored stats
-        self._raw = np.concatenate([valid_x, train_x])
-        self.original_data.mem = self.normalizer.normalize(self._raw)
+        data = np.concatenate([valid_x, train_x])
+        self.original_data.mem = self.normalizer.normalize(data)
         self.original_labels.mem = np.concatenate(
             [valid_y, train_y]).astype(np.int32)
         self.class_lengths = [0, len(valid_x), len(train_x)]
 
-    def state_dict(self) -> dict:
-        state = super().state_dict()
-        meta, arrays = self.normalizer.state_dict()
-        state["normalizer"] = {"meta": meta, "arrays": arrays}
-        return state
-
-    def load_state_dict(self, state: dict) -> None:
-        super().load_state_dict(state)
-        if "normalizer" in state:
-            self.normalizer = normalizer_from_state(
-                state["normalizer"]["meta"], state["normalizer"]["arrays"])
-            if getattr(self, "_raw", None) is not None:
-                self.original_data.map_invalidate()
-                self.original_data.mem = self.normalizer.normalize(self._raw)
+    def _renormalize_served_data(self) -> None:
+        valid_x, _vy, train_x, _ty = self._load_raw()
+        self.original_data.map_invalidate()
+        self.original_data.mem = self.normalizer.normalize(
+            np.concatenate([valid_x, train_x]))

@@ -37,13 +37,23 @@ def window_sum(xp, x, n: int, adjoint: bool = False):
     return acc
 
 
+def _pow_neg_beta(xp, d, beta: float):
+    """``d ** -beta`` with a cheap exact path for the AlexNet exponent:
+    generic pow lowers to exp/log per element; for beta = 3/4,
+    ``d^-3/4 = sqrt(sqrt(d)) / d`` is two sqrts and a divide."""
+    if beta == 0.75:
+        return xp.sqrt(xp.sqrt(d)) / d
+    return d ** (-beta)
+
+
 def forward(xp, x, alpha: float, beta: float, k: float, n: int):
     d = k + alpha * window_sum(xp, x * x, n)
-    return x * d ** (-beta)
+    return x * _pow_neg_beta(xp, d, beta)
 
 
 def backward(xp, x, err_output, alpha: float, beta: float, k: float, n: int):
     d = k + alpha * window_sum(xp, x * x, n)
-    t = err_output * x * d ** (-beta - 1.0)
-    return err_output * d ** (-beta) - 2.0 * alpha * beta * x * window_sum(
+    dnb = _pow_neg_beta(xp, d, beta)
+    t = err_output * x * (dnb / d)           # d^(-beta-1)
+    return err_output * dnb - 2.0 * alpha * beta * x * window_sum(
         xp, t, n, adjoint=True)

@@ -34,18 +34,23 @@ def _window(x, n: int, adjoint: bool):
 
 def _fwd_kernel(n: int, alpha: float, beta: float, k: float,
                 x_ref, y_ref):
+    from znicz_tpu.ops.lrn import _pow_neg_beta
+
     x = x_ref[:]
     d = k + alpha * _window(x * x, n, adjoint=False)
-    y_ref[:] = x * d ** (-beta)
+    y_ref[:] = x * _pow_neg_beta(jnp, d, beta)
 
 
 def _bwd_kernel(n: int, alpha: float, beta: float, k: float,
                 x_ref, e_ref, out_ref):
+    from znicz_tpu.ops.lrn import _pow_neg_beta
+
     x = x_ref[:]
     e = e_ref[:]
     d = k + alpha * _window(x * x, n, adjoint=False)
-    t = e * x * d ** (-beta - 1.0)
-    out_ref[:] = e * d ** (-beta) - 2.0 * alpha * beta * x * _window(
+    dnb = _pow_neg_beta(jnp, d, beta)
+    t = e * x * (dnb / d)
+    out_ref[:] = e * dnb - 2.0 * alpha * beta * x * _window(
         t, n, adjoint=True)
 
 

@@ -87,50 +87,33 @@ class ExportedForward:
 
 
 # -- forge: local model-zoo packaging (reference: veles/forge) --------------
+# Thin compatibility wrappers over the canonical registry implementation
+# (znicz_tpu.utils.forge.ForgeRegistry: manifest + sha256 integrity +
+# semantic version ordering).
+
 def forge_publish(package_path: str, repo_dir: str, name: str,
                   version: str = "1.0", metrics: dict | None = None) -> str:
-    """Publish a forward package into a local forge repository
-    (reference: veles forge upload; manifest.json-driven store)."""
-    entry_dir = os.path.join(repo_dir, name, version)
-    os.makedirs(entry_dir, exist_ok=True)
-    dst = os.path.join(entry_dir, "model.npz")
-    with open(package_path, "rb") as src, open(dst, "wb") as out:
-        out.write(src.read())
-    manifest = {"name": name, "version": version,
-                "metrics": metrics or {}, "file": "model.npz"}
-    with open(os.path.join(entry_dir, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
-    # repo-level index
-    index_path = os.path.join(repo_dir, "index.json")
-    index = {}
-    if os.path.exists(index_path):
-        with open(index_path) as f:
-            index = json.load(f)
-    index.setdefault(name, [])
-    if version not in index[name]:
-        index[name].append(version)
-    with open(index_path, "w") as f:
-        json.dump(index, f, indent=2)
-    return entry_dir
+    """Publish a forward package (reference: veles forge upload)."""
+    from znicz_tpu.utils.forge import ForgeRegistry
+
+    reg = ForgeRegistry(repo_dir)
+    entry = reg.upload(package_path, name, version, metadata=metrics or {})
+    return os.path.join(repo_dir, entry["file"])
 
 
 def forge_fetch(repo_dir: str, name: str,
                 version: str | None = None) -> ExportedForward:
     """Fetch + load a published model (reference: veles forge fetch)."""
-    index_path = os.path.join(repo_dir, "index.json")
-    with open(index_path) as f:
-        index = json.load(f)
-    if name not in index:
-        raise KeyError(f"forge repo has no model {name!r}; available: "
-                       f"{sorted(index)}")
-    version = version or sorted(index[name])[-1]
-    return ExportedForward(os.path.join(repo_dir, name, version,
-                                        "model.npz"))
+    import tempfile
+
+    from znicz_tpu.utils.forge import ForgeRegistry
+
+    reg = ForgeRegistry(repo_dir)
+    dest = os.path.join(tempfile.mkdtemp(prefix="forge_"), "model.npz")
+    return ExportedForward(reg.fetch(name, version, dest=dest))
 
 
 def forge_list(repo_dir: str) -> dict:
-    index_path = os.path.join(repo_dir, "index.json")
-    if not os.path.exists(index_path):
-        return {}
-    with open(index_path) as f:
-        return json.load(f)
+    from znicz_tpu.utils.forge import ForgeRegistry
+
+    return ForgeRegistry(repo_dir).list_packages()

@@ -1,0 +1,169 @@
+"""Post-training report generation — rebuild of veles/publishing/
+(SURVEY.md §3.3 "Publishing": the reference renders a run report through
+pluggable backends; Confluence/wiki backends collapse to the two that
+make sense offline — Markdown and self-contained HTML).
+
+``Publisher.publish(workflow)`` collects everything a run leaves behind —
+config tree, loader split, metric history, best epoch, per-unit timing,
+plotter/image-saver artifacts, device + library versions — into one
+document.  Backends are registered by name like the loader/normalizer
+registries.
+"""
+
+from __future__ import annotations
+
+import html
+import os
+
+from znicz_tpu.core.config import Config, root
+from znicz_tpu.core.logger import Logger
+
+BACKENDS: dict[str, type] = {}
+
+
+def register_backend(name: str):
+    def deco(cls):
+        BACKENDS[name] = cls
+        cls.NAME = name
+        return cls
+    return deco
+
+
+def collect_report(workflow) -> dict:
+    """Gather the report payload (pure data; backends only format)."""
+    import jax
+
+    dec = workflow.decision
+    loader = workflow.loader
+    cfg = {}
+
+    def walk(node, prefix):
+        for key, value in sorted(vars(node).items()):
+            if isinstance(value, Config):
+                walk(value, f"{prefix}{key}.")
+            else:
+                cfg[f"{prefix}{key}"] = repr(value)
+
+    walk(root, "root.")
+    artifacts = []
+    for unit in getattr(workflow, "units", []):
+        for attr in ("destination", "directory"):
+            path = getattr(unit, attr, None)
+            if isinstance(path, str) and os.path.exists(path):
+                artifacts.append((type(unit).__name__, path))
+    return {
+        "name": workflow.name,
+        "device": repr(jax.devices()[0]),
+        "versions": {"jax": jax.__version__},
+        "config": cfg,
+        "class_lengths": list(getattr(loader, "class_lengths", [])),
+        "history": list(dec.metrics_history),
+        "best_metric": dec.best_metric,
+        "best_epoch": dec.best_epoch,
+        "timing": workflow.timing_table(),
+        "artifacts": artifacts,
+    }
+
+
+class BackendBase:
+    """Render a collected report to text."""
+
+    EXT = ".txt"
+
+    def render(self, report: dict) -> str:
+        raise NotImplementedError
+
+
+@register_backend("markdown")
+class MarkdownBackend(BackendBase):
+    EXT = ".md"
+
+    def render(self, report: dict) -> str:
+        lines = [f"# {report['name']} — training report", ""]
+        lines += [f"- device: `{report['device']}`",
+                  f"- jax: {report['versions']['jax']}",
+                  f"- dataset (test/valid/train): "
+                  f"{report['class_lengths']}",
+                  f"- best metric: **{report['best_metric']}** "
+                  f"(epoch {report['best_epoch']})", ""]
+        if report["history"]:
+            keys = sorted({k for h in report["history"] for k in h})
+            lines += ["## Metrics", "",
+                      "| " + " | ".join(keys) + " |",
+                      "|" + "---|" * len(keys)]
+            for h in report["history"]:
+                lines.append(
+                    "| " + " | ".join(str(h.get(k, "")) for k in keys)
+                    + " |")
+            lines.append("")
+        if report["artifacts"]:
+            lines += ["## Artifacts", ""]
+            lines += [f"- {kind}: `{path}`"
+                      for kind, path in report["artifacts"]]
+            lines.append("")
+        lines += ["## Timing", "", "```", report["timing"], "```", ""]
+        lines += ["## Config", "", "```"]
+        lines += [f"{k} = {v}" for k, v in sorted(report["config"].items())]
+        lines += ["```", ""]
+        return "\n".join(lines)
+
+
+@register_backend("html")
+class HtmlBackend(BackendBase):
+    EXT = ".html"
+
+    def render(self, report: dict) -> str:
+        h = html.escape
+        rows = ""
+        keys = sorted({k for hh in report["history"] for k in hh})
+        if keys:
+            head = "".join(f"<th>{h(k)}</th>" for k in keys)
+            body = "".join(
+                "<tr>" + "".join(f"<td>{h(str(hh.get(k, '')))}</td>"
+                                 for k in keys) + "</tr>"
+                for hh in report["history"])
+            rows = f"<table><tr>{head}</tr>{body}</table>"
+        arts = "".join(f"<li>{h(kind)}: <code>{h(path)}</code></li>"
+                       for kind, path in report["artifacts"])
+        cfg = "\n".join(f"{h(k)} = {h(v)}"
+                        for k, v in sorted(report["config"].items()))
+        return (
+            f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{h(report['name'])}</title></head><body>"
+            f"<h1>{h(report['name'])} — training report</h1>"
+            f"<p>device {h(report['device'])}, "
+            f"jax {h(report['versions']['jax'])}, "
+            f"best {h(str(report['best_metric']))} "
+            f"(epoch {report['best_epoch']})</p>"
+            f"{rows}<ul>{arts}</ul>"
+            f"<h2>Timing</h2><pre>{h(report['timing'])}</pre>"
+            f"<h2>Config</h2><pre>{cfg}</pre>"
+            f"</body></html>")
+
+
+class Publisher(Logger):
+    """Render + write a run report (reference: veles/publishing/...
+    backends selected by name, ``root.common.publishing.backend``)."""
+
+    def __init__(self, backend: str | None = None,
+                 directory: str | None = None) -> None:
+        super().__init__()
+        name = backend or root.common.get("publishing", Config()).get(
+            "backend", "markdown")
+        if name not in BACKENDS:
+            raise KeyError(f"unknown publishing backend {name!r}; "
+                           f"registered: {sorted(BACKENDS)}")
+        self.backend = BACKENDS[name]()
+        self.directory = directory or os.getcwd()
+
+    def publish(self, workflow) -> str:
+        """Write the report; returns the output path."""
+        report = collect_report(workflow)
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(
+            self.directory,
+            f"{report['name'].lower()}_report{self.backend.EXT}")
+        with open(path, "w") as f:
+            f.write(self.backend.render(report))
+        self.info(f"report -> {path}")
+        return path
