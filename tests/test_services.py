@@ -178,13 +178,18 @@ def test_forge_upload_fetch_roundtrip(tmp_path):
     with pytest.raises(FileExistsError):
         reg.upload_workflow(w, "wine", "1.0")
     reg.upload_workflow(w, "wine", "1.1")
-    # latest fetch + checksum + inference parity with the live workflow
+    # latest fetch + checksum + inference parity with a direct export
+    from znicz_tpu.utils.export import export_forward
+    direct = str(tmp_path / "direct.npz")
+    export_forward(w, direct)
     dest = reg.fetch("wine", dest=str(tmp_path / "got.npz"))
     loaded = ExportedForward(dest)
     x = np.asarray(w.loader.original_data.map_read()[:8], np.float32)
-    live = w.forwards[0]
-    got = loaded(x)
-    assert got.shape[0] == 8
+    np.testing.assert_allclose(loaded(x), ExportedForward(direct)(x),
+                               rtol=1e-6)
+    # in-place fetch serves the registry file itself (no copy)
+    in_place = reg.fetch("wine")
+    assert in_place.startswith(str(tmp_path / "registry"))
     with pytest.raises(KeyError):
         reg.fetch("nonexistent")
     with pytest.raises(KeyError):

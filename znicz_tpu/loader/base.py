@@ -70,6 +70,9 @@ class Loader(AcceleratedUnit):
         self.last_minibatch = False
         self.epoch_number = 0
         self.epoch_ended = False
+        #: set by FusedTrainStep._pin_dataset: the consumer reads only
+        #: minibatch_indices, so skip per-step data gather/upload
+        self.serve_indices_only = False
         # dataset geometry, set by load_data()
         self.class_lengths = [0, 0, 0]
         self._position = 0               # offset within current class
@@ -140,6 +143,11 @@ class Loader(AcceleratedUnit):
 
     def xla_run(self) -> None:
         self._serve()
+        if self.serve_indices_only:
+            # the fused step pinned the dataset on HBM: it consumes only
+            # minibatch_indices, so the host gather + device upload of the
+            # minibatch itself would be pure dead work on the hot loop
+            return
         # upload the freshly filled host rows
         for arr in (self.minibatch_data, self.minibatch_labels,
                     self.minibatch_targets):
@@ -161,7 +169,8 @@ class Loader(AcceleratedUnit):
         self.minibatch_offset = start
         self._position = start + count
         self.last_minibatch = self._position >= length
-        self.fill_minibatch()
+        if not self.serve_indices_only:
+            self.fill_minibatch()
         if self.last_minibatch:
             self._advance_class()
 

@@ -95,6 +95,9 @@ class FusedTrainStep(Unit):
         self._key = None          # device-resident PRNG key, split per step
         self._train_fn = None
         self._eval_fn = None
+        self._dataset_dev = None  # HBM-pinned (data, labels) full batch
+        self._train_fn_idx = None
+        self._eval_fn_idx = None
         self._scan_fn = None      # lazily-built K-step lax.scan variant
         self._hyper_cache = None  # (signature, device pytree)
         self._acc = None          # device-side metric sums (deferred mode)
@@ -285,6 +288,16 @@ class FusedTrainStep(Unit):
         metrics["bs"] = jax.lax.psum(mask.sum(), "data")
         return metrics
 
+    # index-fed variants: the dataset lives on HBM (see initialize); the
+    # host ships ~4 bytes/sample of indices per step instead of the
+    # minibatch itself (reference: FullBatchLoader's ``on_device`` option)
+    def _local_train_idx(self, params, key, hyper, data, labels, idx, mask):
+        return self._local_train(params, key, hyper, data[idx],
+                                 labels[idx], mask)
+
+    def _local_eval_idx(self, params, data, labels, idx, mask):
+        return self._local_eval(params, data[idx], labels[idx], mask)
+
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, device=None, **kwargs) -> None:
         # the step subsumes the segment units: they are not in the control
@@ -319,7 +332,50 @@ class FusedTrainStep(Unit):
         donate = (0, 1) if self.donate else ()
         self._train_fn = jax.jit(train, donate_argnums=donate)
         self._eval_fn = jax.jit(evalf)
+        self._pin_dataset()
         self.initialized = True
+
+    def _pin_dataset(self) -> None:
+        """Place a full-batch dataset on HBM so the hot loop ships only
+        minibatch INDICES — per-step host->device data transfer (the
+        dominant cost for image workflows) disappears.  Gated on size
+        (``root.common.engine.dataset_on_device_max_bytes``, default 1
+        GiB) and on the loader exposing ``original_data``."""
+        self._dataset_dev = None
+        self._train_fn_idx = self._eval_fn_idx = None
+        loader = self.loader
+        data_arr = getattr(loader, "original_data", None)
+        if loader is None or not data_arr:
+            return
+        if isinstance(self.evaluator, EvaluatorMSE):
+            labels_arr = getattr(loader, "original_targets", None)
+        else:
+            labels_arr = getattr(loader, "original_labels", None)
+        if not labels_arr:
+            return
+        limit = int(root.common.engine.get(
+            "dataset_on_device_max_bytes", 1 << 30))
+        data = np.asarray(data_arr.mem, np.float32)
+        if data.nbytes > limit:
+            return
+        from jax.sharding import NamedSharding
+        rep_sh = NamedSharding(self.mesh, P())
+        self._dataset_dev = (
+            jax.device_put(data, rep_sh),
+            jax.device_put(np.asarray(labels_arr.mem), rep_sh))
+        rep, sh = P(), P("data")
+        train = shard_map(self._local_train_idx, mesh=self.mesh,
+                          in_specs=(rep, rep, rep, rep, rep, sh, sh),
+                          out_specs=(rep, rep, rep))
+        evalf = shard_map(self._local_eval_idx, mesh=self.mesh,
+                          in_specs=(rep, rep, rep, sh, sh),
+                          out_specs=rep)
+        donate = (0, 1) if self.donate else ()
+        self._train_fn_idx = jax.jit(train, donate_argnums=donate)
+        self._eval_fn_idx = jax.jit(evalf)
+        # the loader now only needs to serve indices — its per-step host
+        # gather + device upload of the minibatch would be dead work
+        loader.serve_indices_only = True
 
     def _build_scan_fn(self):
         """K-step variant: ``lax.scan`` over stacked minibatches inside the
@@ -357,18 +413,35 @@ class FusedTrainStep(Unit):
     # -- per-minibatch control callback -------------------------------------
     def run(self) -> None:
         loader = self.loader
+        mask = loader.minibatch_indices.mem >= 0
+        if self._dataset_dev is not None:
+            # index-fed hot path: dataset already on HBM
+            idx = np.maximum(loader.minibatch_indices.mem, 0).astype(
+                np.int32)
+            data, labels_all = self._dataset_dev
+            if int(loader.minibatch_class) == TRAIN:
+                self._params, self._key, metrics = self._train_fn_idx(
+                    self._params, self._key, self._hyper_device(),
+                    data, labels_all, idx, mask)
+            else:
+                metrics = self._eval_fn_idx(self._params, data, labels_all,
+                                            idx, mask)
+            self._finish_run(loader, metrics)
+            return
         x = loader.minibatch_data.mem
         if isinstance(self.evaluator, EvaluatorMSE):
             labels = loader.minibatch_targets.mem
         else:
             labels = loader.minibatch_labels.mem
-        mask = loader.minibatch_indices.mem >= 0
         if int(loader.minibatch_class) == TRAIN:
             self._params, self._key, metrics = self._train_fn(
                 self._params, self._key, self._hyper_device(),
                 x, labels, mask)
         else:
             metrics = self._eval_fn(self._params, x, labels, mask)
+        self._finish_run(loader, metrics)
+
+    def _finish_run(self, loader, metrics) -> None:
         if not self.defer_metrics:
             self._publish(jax.device_get(metrics))
             return

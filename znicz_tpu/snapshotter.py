@@ -125,6 +125,9 @@ def restore_state(workflow, path: str) -> dict:
     step = getattr(workflow, "step", None)
     if step is not None and getattr(step, "_params", None) is not None:
         step._params = step.gather_params()  # re-place restored weights
+        # a restored normalizer may have re-normalized the loader's served
+        # data: refresh the HBM-pinned dataset copy too
+        step._pin_dataset()
         if "step.key" in arrays:
             from jax.sharding import NamedSharding, PartitionSpec
             step._key = jax.device_put(

@@ -103,14 +103,11 @@ def forge_publish(package_path: str, repo_dir: str, name: str,
 
 def forge_fetch(repo_dir: str, name: str,
                 version: str | None = None) -> ExportedForward:
-    """Fetch + load a published model (reference: veles forge fetch)."""
-    import tempfile
-
+    """Fetch + load a published model (reference: veles forge fetch) —
+    read in place from the registry (checksum-verified), no copy."""
     from znicz_tpu.utils.forge import ForgeRegistry
 
-    reg = ForgeRegistry(repo_dir)
-    dest = os.path.join(tempfile.mkdtemp(prefix="forge_"), "model.npz")
-    return ExportedForward(reg.fetch(name, version, dest=dest))
+    return ExportedForward(ForgeRegistry(repo_dir).fetch(name, version))
 
 
 def forge_list(repo_dir: str) -> dict:

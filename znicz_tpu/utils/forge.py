@@ -12,6 +12,8 @@ behavior the server provided.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import hashlib
 import json
 import os
@@ -52,6 +54,19 @@ class ForgeRegistry(Logger):
     def _manifest_path(self) -> str:
         return os.path.join(self.dir, MANIFEST)
 
+    @contextlib.contextmanager
+    def _locked(self):
+        """Exclusive advisory lock over the registry: uploads are
+        read-modify-write on the manifest, and the docstring's
+        shared-filesystem promise needs them serialized."""
+        os.makedirs(self.dir, exist_ok=True)
+        with open(os.path.join(self.dir, ".lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+
     def _load_manifest(self) -> dict:
         try:
             with open(self._manifest_path()) as f:
@@ -72,19 +87,19 @@ class ForgeRegistry(Logger):
         """Register a forward package (utils/export.py .npz) under
         ``name``/``version``; re-uploading an existing version is refused
         (reference semantics: packages are immutable)."""
-        manifest = self._load_manifest()
-        versions = manifest.setdefault(name, {})
-        if version in versions:
-            raise FileExistsError(f"{name}=={version} already in the "
-                                  f"registry (packages are immutable)")
-        fname = f"{name}-{version}.npz"
-        os.makedirs(self.dir, exist_ok=True)
-        shutil.copyfile(package_path, os.path.join(self.dir, fname))
-        entry = {"file": fname,
-                 "sha256": _sha256(os.path.join(self.dir, fname)),
-                 "metadata": metadata or {}}
-        versions[version] = entry
-        self._save_manifest(manifest)
+        with self._locked():
+            manifest = self._load_manifest()
+            versions = manifest.setdefault(name, {})
+            if version in versions:
+                raise FileExistsError(f"{name}=={version} already in the "
+                                      f"registry (packages are immutable)")
+            fname = f"{name}-{version}.npz"
+            shutil.copyfile(package_path, os.path.join(self.dir, fname))
+            entry = {"file": fname,
+                     "sha256": _sha256(os.path.join(self.dir, fname)),
+                     "metadata": metadata or {}}
+            versions[version] = entry
+            self._save_manifest(manifest)
         self.info(f"forge: uploaded {name}=={version}")
         return entry
 
@@ -95,14 +110,16 @@ class ForgeRegistry(Logger):
 
         tmp = os.path.join(self.dir, f".upload-{name}-{version}.npz")
         os.makedirs(self.dir, exist_ok=True)
-        export_forward(workflow, tmp)
         try:
+            export_forward(workflow, tmp)
             meta = {"workflow": workflow.name,
                     "best_metric": workflow.decision.best_metric,
                     **(metadata or {})}
             return self.upload(tmp, name, version, meta)
         finally:
-            os.unlink(tmp)
+            # a failed export must surface ITS error, not the cleanup's
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(tmp)
 
     def list_packages(self) -> dict:
         """name -> version list in semantic order."""
@@ -111,8 +128,9 @@ class ForgeRegistry(Logger):
 
     def fetch(self, name: str, version: str | None = None,
               dest: str | None = None) -> str:
-        """Copy a package out of the registry (latest version when
-        unspecified), verifying its checksum; returns the local path."""
+        """Resolve a package (latest version when unspecified), verify its
+        checksum and return a local path: the in-registry file for
+        read-only use, or a copy when ``dest`` is given."""
         manifest = self._load_manifest()
         if name not in manifest:
             raise KeyError(f"unknown forge package {name!r}; have "
@@ -127,7 +145,9 @@ class ForgeRegistry(Logger):
         if _sha256(src) != entry["sha256"]:
             raise IOError(f"forge package {name}=={version} is corrupt "
                           f"(sha256 mismatch)")
-        dest = dest or os.path.join(os.getcwd(), entry["file"])
+        if dest is None:
+            self.info(f"forge: fetched {name}=={version} (in place)")
+            return src
         shutil.copyfile(src, dest)
         self.info(f"forge: fetched {name}=={version} -> {dest}")
         return dest
