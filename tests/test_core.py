@@ -229,3 +229,29 @@ def test_timing_table():
     wf.run()
     table = wf.timing_table()
     assert "a" in table and "runs" in table
+
+
+def test_metrics_jsonl_sink(tmp_path):
+    """root.common.metrics_file streams one JSON object per epoch
+    (SURVEY §6.5 machine-readable metrics)."""
+    import json
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.models import wine
+
+    path = tmp_path / "metrics.jsonl"
+    root.common.metrics_file = str(path)
+    try:
+        prng.seed_all(3)
+        w = wine.build(max_epochs=3, n_train=60, n_valid=30,
+                       minibatch_size=10)
+        w.initialize(device=TPUDevice())
+        w.run()
+    finally:
+        del root.common.metrics_file
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [rec["epoch"] for rec in lines] == [1, 2, 3]
+    assert all("metric_validation" in rec and rec["workflow"] == "Wine"
+               for rec in lines), lines

@@ -181,17 +181,28 @@ class FileImageLoader(NormalizerStateMixin, Loader):
 class FullBatchImageLoader(FileImageLoader):
     """Directory-per-class loader that materializes the whole decoded
     dataset in host memory at load time (reference:
-    FullBatchImageLoader) — trades RAM for zero per-minibatch decode."""
+    FullBatchImageLoader) — trades RAM for zero per-minibatch decode.
+    The dataset lives in ``original_data``/``original_labels`` Arrays
+    (the FullBatchLoader contract), so the fused step's HBM pinning
+    engages and the hot loop serves indices only."""
+
+    def __init__(self, workflow=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        from znicz_tpu.core.memory import Array
+        self.original_data = Array()
+        self.original_labels = Array()
 
     def load_data(self) -> None:
         super().load_data()
-        self._decoded = self.normalizer.normalize(np.stack([
+        self.original_data.mem = self.normalizer.normalize(np.stack([
             _decode(p, self.sample_shape) for p in self._paths]))
+        self.original_labels.mem = np.asarray(self._labels, np.int32)
 
     def _renormalize_served_data(self) -> None:
         # restore swapped the normalizer in: re-decode from disk (the
         # tree is still there) instead of keeping a raw in-RAM copy
-        self._decoded = self.normalizer.normalize(np.stack([
+        self.original_data.map_invalidate()
+        self.original_data.mem = self.normalizer.normalize(np.stack([
             _decode(p, self.sample_shape) for p in self._paths]))
 
     def fill_minibatch(self) -> None:
@@ -200,7 +211,7 @@ class FullBatchImageLoader(FileImageLoader):
         data = np.zeros((self.max_minibatch_size,) + self.sample_shape,
                         np.float32)
         labels = np.zeros((self.max_minibatch_size,), np.int32)
-        data[:count] = self._decoded[indices[:count]]
-        labels[:count] = self._labels[indices[:count]]
+        data[:count] = self.original_data.mem[indices[:count]]
+        labels[:count] = self.original_labels.mem[indices[:count]]
         self.minibatch_data.mem = data
         self.minibatch_labels.mem = labels

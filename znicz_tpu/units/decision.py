@@ -19,9 +19,15 @@ from typing import Optional
 
 import numpy as np
 
+import uuid
+
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TEST, VALID, TRAIN, CLASS_NAMES
+
+#: one id per process: JSONL consumers disambiguate records when a
+#: resumed run re-appends epochs an earlier (crashed) run already wrote
+_RUN_ID = uuid.uuid4().hex[:12]
 
 
 class DecisionBase(Unit):
@@ -94,6 +100,7 @@ class DecisionBase(Unit):
                for c in (TEST, VALID, TRAIN)
                if self.epoch_metrics[c] is not None},
         })
+        self._append_metrics_jsonl()
         self.on_epoch_logged()
         if self.max_epochs is not None and \
                 int(self.epoch_number) >= self.max_epochs:
@@ -107,6 +114,23 @@ class DecisionBase(Unit):
 
     def on_epoch_logged(self) -> None:
         pass
+
+    def _append_metrics_jsonl(self) -> None:
+        """Append the epoch record to ``root.common.metrics_file`` when
+        set (SURVEY.md §6.5 "metrics to jsonl" — the machine-readable
+        sibling of the console log; one JSON object per line)."""
+        from znicz_tpu.core.config import root
+
+        path = root.common.get("metrics_file", None)
+        if not path:
+            return
+        import json
+
+        with open(str(path), "a") as f:
+            f.write(json.dumps({"workflow": self.workflow.name
+                                if self.workflow else None,
+                                "run_id": _RUN_ID,
+                                **self.metrics_history[-1]}) + "\n")
 
     # -- snapshot support ---------------------------------------------------
     def state_dict(self) -> dict:

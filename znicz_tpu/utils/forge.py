@@ -108,7 +108,8 @@ class ForgeRegistry(Logger):
         """Export ``workflow``'s forward chain and upload it in one go."""
         from znicz_tpu.utils.export import export_forward
 
-        tmp = os.path.join(self.dir, f".upload-{name}-{version}.npz")
+        tmp = os.path.join(self.dir,
+                           f".upload-{name}-{version}.{os.getpid()}.npz")
         os.makedirs(self.dir, exist_ok=True)
         try:
             export_forward(workflow, tmp)
