@@ -274,11 +274,15 @@ def bench_mnist_wallclock(n_train=6000, n_valid=1000, target_pct=1.0,
     the undownloadable real files; same byte format, same loader path)."""
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
     from znicz_tpu.models.mnist_conv import build
 
     t0 = time.time()
     prng.seed_all(7)
     target = int(n_valid * target_pct / 100.0)
+    # one compiled scan per class pass — per-minibatch dispatch latency
+    # (~14 ms through the sandbox tunnel) leaves the wall-clock entirely
+    root.common.engine.scan_epoch = True
     w = build(max_epochs=max_epochs, minibatch_size=200, n_train=n_train,
               n_valid=n_valid)
     w.decision.target_metric = target

@@ -154,6 +154,63 @@ def test_train_steps_scan_matches_sequential(cpu_devices):
         0.5 * float(jax.device_get(h0[0]["lr"]))
 
 
+def test_scan_epoch_mode_matches_per_minibatch(cpu_devices):
+    """root.common.engine.scan_epoch dispatches one compiled scan per
+    class pass; Decision history and final weights must match the
+    per-minibatch path (same key chain, same math, one dispatch)."""
+    from znicz_tpu.core.config import root
+
+    def run(scan):
+        root.common.engine.scan_epoch = scan
+        try:
+            w = run_fused(41, data_parallel_mesh(4), max_epochs=3)
+        finally:
+            root.common.engine.scan_epoch = False
+        return w
+
+    base = run(False)
+    scan = run(True)
+    assert scan.step.scan_epoch and scan.step._scan_idx_fns
+    assert [h["metric_validation"] for h in base.decision.metrics_history] \
+        == [h["metric_validation"] for h in scan.decision.metrics_history]
+    assert [h["metric_train"] for h in base.decision.metrics_history] \
+        == [h["metric_train"] for h in scan.decision.metrics_history]
+    np.testing.assert_allclose(scan.forwards[0].weights.map_read(),
+                               base.forwards[0].weights.map_read(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_scan_epoch_single_minibatch_classes(cpu_devices):
+    """Regression: when a class pass fits in ONE minibatch, the loader
+    has already advanced to the next class (and possibly reshuffled) by
+    the time the step dispatches — the plan must be the one captured at
+    class start, not the next class's indices."""
+    from znicz_tpu.core.config import root
+
+    def run(scan):
+        prng.seed_all(19)
+        root.common.engine.scan_epoch = scan
+        try:
+            # valid (80) and train (160) each fit in one 160-row minibatch
+            w = build_fused(max_epochs=3, n_train=160, n_valid=80,
+                            minibatch_size=160,
+                            mesh=data_parallel_mesh(4))
+            w.initialize(device=TPUDevice())
+            w.run()
+            w.step.sync_to_units()
+        finally:
+            root.common.engine.scan_epoch = False
+        return w
+
+    base = run(False)
+    scan = run(True)
+    assert [h["metric_validation"] for h in base.decision.metrics_history] \
+        == [h["metric_validation"] for h in scan.decision.metrics_history]
+    np.testing.assert_allclose(scan.forwards[0].weights.map_read(),
+                               base.forwards[0].weights.map_read(),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_lr_schedule_no_recompile(cpu_devices):
     """Hyperparams are traced scalars: mutating gd.learning_rate between
     steps must not retrigger compilation."""

@@ -73,6 +73,7 @@ class Loader(AcceleratedUnit):
         #: set by FusedTrainStep._pin_dataset: the consumer reads only
         #: minibatch_indices, so skip per-step data gather/upload
         self.serve_indices_only = False
+        self._current_plan = None        # captured at each class start
         # dataset geometry, set by load_data()
         self.class_lengths = [0, 0, 0]
         self._position = 0               # offset within current class
@@ -169,10 +170,34 @@ class Loader(AcceleratedUnit):
         self.minibatch_offset = start
         self._position = start + count
         self.last_minibatch = self._position >= length
+        if start == 0:
+            self._current_plan = self._capture_class_plan(cls)
         if not self.serve_indices_only:
             self.fill_minibatch()
         if self.last_minibatch:
             self._advance_class()
+
+    def class_plan(self) -> np.ndarray:
+        """The FULL minibatch plan of the class currently being served:
+        ``(n_minibatches, max_minibatch_size)`` int64 indices, -1 padding
+        on the final partial row.  Captured at the first serve of the
+        class pass — for a single-minibatch class, ``_advance_class``
+        (and the epoch-boundary reshuffle) has ALREADY run by the time
+        the consumer acts, so reading ``_shuffled`` lazily would hand out
+        the next class's plan.  Consumers (FusedTrainStep epoch scanning)
+        dispatch one compiled scan over it instead of one program per
+        minibatch."""
+        return self._current_plan
+
+    def _capture_class_plan(self, cls: int) -> np.ndarray:
+        order = self._shuffled[cls]
+        length = self.class_lengths[cls]
+        bs = self.max_minibatch_size
+        n_mb = -(-length // bs)
+        plan = np.full((n_mb, bs), -1, dtype=np.int64)
+        flat = plan.reshape(-1)
+        flat[:length] = order[:length]
+        return plan
 
     def _advance_class(self) -> None:
         classes = self._nonempty_classes()

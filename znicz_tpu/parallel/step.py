@@ -72,8 +72,16 @@ class FusedTrainStep(Unit):
     def __init__(self, workflow=None, forwards=None, evaluator=None,
                  gds=None, loader=None, mesh: Optional[Mesh] = None,
                  donate: bool = True, defer_metrics: bool = True,
-                 **kwargs) -> None:
+                 scan_epoch: Optional[bool] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
+        #: dispatch one compiled lax.scan per CLASS PASS instead of one
+        #: program per minibatch (requires the pinned dataset; same
+        #: "virtual minibatch" Decision accounting as defer_metrics).
+        #: Hyperparams are read once per pass, so per-MINIBATCH LR
+        #: schedules (LearningRateAdjust by_epoch=False) collapse to
+        #: per-pass granularity in this mode; per-epoch schedules are
+        #: unaffected.  None -> root.common.engine.scan_epoch (False)
+        self.scan_epoch = scan_epoch
         self.forwards = list(forwards or [])
         self.evaluator = evaluator
         #: gradient units in FORWARD order (gds[i] pairs forwards[i]);
@@ -98,6 +106,7 @@ class FusedTrainStep(Unit):
         self._dataset_dev = None  # HBM-pinned (data, labels) full batch
         self._train_fn_idx = None
         self._eval_fn_idx = None
+        self._scan_idx_fns = {}   # "train"/"eval" -> class-pass scan fn
         self._scan_fn = None      # lazily-built K-step lax.scan variant
         self._hyper_cache = None  # (signature, device pytree)
         self._acc = None          # device-side metric sums (deferred mode)
@@ -376,6 +385,46 @@ class FusedTrainStep(Unit):
         # the loader now only needs to serve indices — its per-step host
         # gather + device upload of the minibatch would be dead work
         loader.serve_indices_only = True
+        if self.scan_epoch is None:
+            self.scan_epoch = bool(root.common.engine.get("scan_epoch",
+                                                          False))
+        if self.scan_epoch:
+            self._build_scan_idx_fns()
+
+    def _build_scan_idx_fns(self) -> None:
+        """Class-pass scan programs over the index plan: ONE dispatch per
+        class pass (train or eval) — per-minibatch host dispatch latency
+        leaves the hot loop entirely."""
+        def local_train_many(params, key, hyper, data, labels, idxs, ms):
+            def body(carry, inp):
+                p, k = carry
+                idx, m = inp
+                p, k, metrics = self._local_train(p, k, hyper, data[idx],
+                                                  labels[idx], m)
+                return (p, k), metrics
+            (params, key), mets = jax.lax.scan(
+                body, (params, key), (idxs, ms))
+            return params, key, jax.tree.map(lambda a: a.sum(0), mets)
+
+        def local_eval_many(params, data, labels, idxs, ms):
+            def body(_, inp):
+                idx, m = inp
+                return None, self._local_eval(params, data[idx],
+                                              labels[idx], m)
+            _, mets = jax.lax.scan(body, None, (idxs, ms))
+            return jax.tree.map(lambda a: a.sum(0), mets)
+
+        rep = P()
+        shs = P(None, "data")
+        donate = (0, 1) if self.donate else ()
+        self._scan_idx_fns["train"] = jax.jit(shard_map(
+            local_train_many, mesh=self.mesh,
+            in_specs=(rep, rep, rep, rep, rep, shs, shs),
+            out_specs=(rep, rep, rep)), donate_argnums=donate)
+        self._scan_idx_fns["eval"] = jax.jit(shard_map(
+            local_eval_many, mesh=self.mesh,
+            in_specs=(rep, rep, rep, shs, shs),
+            out_specs=rep))
 
     def _build_scan_fn(self):
         """K-step variant: ``lax.scan`` over stacked minibatches inside the
@@ -413,6 +462,9 @@ class FusedTrainStep(Unit):
     # -- per-minibatch control callback -------------------------------------
     def run(self) -> None:
         loader = self.loader
+        if self._dataset_dev is not None and self._scan_idx_fns:
+            self._run_scanned_class(loader)
+            return
         mask = loader.minibatch_indices.mem >= 0
         if self._dataset_dev is not None:
             # index-fed hot path: dataset already on HBM
@@ -440,6 +492,35 @@ class FusedTrainStep(Unit):
         else:
             metrics = self._eval_fn(self._params, x, labels, mask)
         self._finish_run(loader, metrics)
+
+    def _run_scanned_class(self, loader) -> None:
+        """Epoch-scan mode: the FIRST minibatch of a class pass dispatches
+        the whole pass as one scanned program; the control loop keeps
+        iterating (the loader serves indices cheaply) and the summed
+        metrics land at the last minibatch — the same "virtual minibatch"
+        the Decision already sees in deferred mode."""
+        if int(loader.minibatch_offset) == 0:
+            plan = loader.class_plan()
+            idxs = jnp.asarray(np.maximum(plan, 0).astype(np.int32))
+            ms = jnp.asarray(plan >= 0)
+            data, labels = self._dataset_dev
+            if int(loader.minibatch_class) == TRAIN:
+                self._params, self._key, metrics = \
+                    self._scan_idx_fns["train"](
+                        self._params, self._key, self._hyper_device(),
+                        data, labels, idxs, ms)
+            else:
+                metrics = self._scan_idx_fns["eval"](
+                    self._params, data, labels, idxs, ms)
+            self._acc = metrics
+        if loader.last_minibatch:
+            self._publish(jax.device_get(self._acc))
+            self._acc = None
+        else:
+            self.n_err = 0
+            self.mse = 0.0
+            self.loss = 0.0
+            self.minibatch_size = 0
 
     def _finish_run(self, loader, metrics) -> None:
         if not self.defer_metrics:

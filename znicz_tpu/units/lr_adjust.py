@@ -5,7 +5,11 @@ The unit sits in the control graph (after Decision) and mutates the
 ``learning_rate`` / ``learning_rate_bias`` of its linked gradient units.
 TPU note: the fused training step reads per-layer hyperparams as traced
 scalars on every call (znicz_tpu.parallel.step.hyper_params), so schedule
-mutations take effect immediately without recompilation.
+mutations take effect immediately without recompilation.  Exception:
+in epoch-scan mode (``root.common.engine.scan_epoch``) a whole class
+pass compiles into one dispatch and hyperparams are read once per pass —
+per-minibatch (``by_epoch=False``) schedules coarsen to per-pass there;
+per-epoch schedules are unaffected.
 """
 
 from __future__ import annotations
