@@ -282,16 +282,20 @@ def bench_mnist_wallclock(n_train=6000, n_valid=1000, target_pct=1.0,
     target = int(n_valid * target_pct / 100.0)
     # one compiled scan per class pass — per-minibatch dispatch latency
     # (~14 ms through the sandbox tunnel) leaves the wall-clock entirely
+    prev_scan = root.common.engine.get("scan_epoch", False)
     root.common.engine.scan_epoch = True
     w = build(max_epochs=max_epochs, minibatch_size=200, n_train=n_train,
               n_valid=n_valid)
     w.decision.target_metric = target
-    w.initialize(device=TPUDevice())
-    print(f"# mnist_wallclock: initialized in {time.time() - t0:.1f}s",
-          file=sys.stderr)
-    t0 = time.perf_counter()
-    w.run()
-    wall = time.perf_counter() - t0
+    try:
+        w.initialize(device=TPUDevice())
+        print(f"# mnist_wallclock: initialized in {time.time() - t0:.1f}s",
+              file=sys.stderr)
+        t0 = time.perf_counter()
+        w.run()
+        wall = time.perf_counter() - t0
+    finally:
+        root.common.engine.scan_epoch = prev_scan
     hist = w.decision.metrics_history
     reached = hist[-1]["metric_validation"] <= target
     _emit("mnist_conv_wallclock_to_99pct_sec", wall, unit="s",

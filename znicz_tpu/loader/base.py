@@ -73,6 +73,9 @@ class Loader(AcceleratedUnit):
         #: set by FusedTrainStep._pin_dataset: the consumer reads only
         #: minibatch_indices, so skip per-step data gather/upload
         self.serve_indices_only = False
+        #: set by FusedTrainStep._build_scan_idx_fns: capture the class
+        #: plan at each class start (dead work for everyone else)
+        self.capture_class_plan = False
         self._current_plan = None        # captured at each class start
         # dataset geometry, set by load_data()
         self.class_lengths = [0, 0, 0]
@@ -170,7 +173,7 @@ class Loader(AcceleratedUnit):
         self.minibatch_offset = start
         self._position = start + count
         self.last_minibatch = self._position >= length
-        if start == 0:
+        if start == 0 and self.capture_class_plan:
             self._current_plan = self._capture_class_plan(cls)
         if not self.serve_indices_only:
             self.fill_minibatch()

@@ -425,6 +425,9 @@ class FusedTrainStep(Unit):
             local_eval_many, mesh=self.mesh,
             in_specs=(rep, rep, rep, shs, shs),
             out_specs=rep))
+        # plan capture costs an int64 matrix per class pass — only pay it
+        # when this mode actually consumes it
+        self.loader.capture_class_plan = True
 
     def _build_scan_fn(self):
         """K-step variant: ``lax.scan`` over stacked minibatches inside the
@@ -462,9 +465,13 @@ class FusedTrainStep(Unit):
     # -- per-minibatch control callback -------------------------------------
     def run(self) -> None:
         loader = self.loader
-        if self._dataset_dev is not None and self._scan_idx_fns:
+        if self._dataset_dev is not None and self._scan_idx_fns and \
+                (int(loader.minibatch_offset) == 0 or
+                 self._acc is not None):
             self._run_scanned_class(loader)
             return
+        # (a class pass entered MID-WAY — restored loader state — falls
+        # through to the per-minibatch path for the remainder)
         mask = loader.minibatch_indices.mem >= 0
         if self._dataset_dev is not None:
             # index-fed hot path: dataset already on HBM
