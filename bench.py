@@ -238,6 +238,48 @@ def bench_deconv_ae(batch=256, K=16, reps=3):
           w.forwards, batch)
 
 
+def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
+                      vocab=32000, K=4, reps=3):
+    """Beyond-parity headline: decoder-transformer training throughput
+    (ring-attention-capable stack on a 1-chip mesh), tokens/sec/chip."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel import transformer as tfm
+    from znicz_tpu.parallel.mesh import make_mesh
+
+    t0 = time.time()
+    prng.seed_all(7)
+    mesh = make_mesh({"data": 1, "seq": 1, "model": 1})
+    params = tfm.init_params(prng.get(), n_layers, d, heads, 4 * d, vocab)
+    step, _ = tfm.make_train_step(mesh, n_layers, d, heads, 4 * d, vocab,
+                                  lr=1e-3)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1))
+    params, loss = step(params, tokens, labels)       # compile + warm
+    float(jax.device_get(loss))
+    print(f"# transformer: initialized in {time.time() - t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    for _ in range(K * reps):
+        params, loss = step(params, tokens, labels)
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    tps = batch * seq * K * reps / dt
+    # MFU via the standard 6*N*T estimate (params N dominated by matmuls)
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(params))
+    from znicz_tpu.utils import flops as flops_mod
+    peak = flops_mod.peak_flops()
+    extra = {}
+    if peak and jax.default_backend() != "cpu":
+        extra["mfu"] = round(6.0 * n_params * tps / peak, 4)
+    _emit(f"transformer_l{n_layers}d{d}s{seq}_train_tokens_per_sec_per_chip",
+          tps, unit="tokens/sec", **extra)
+
+
 def bench_kohonen(n_train=4000, minibatch=500, epochs=3):
     """BASELINE.md config 5: Kohonen SOM winner-take-all training.  The
     SOM trainer is its own accelerated unit (not a FusedTrainStep), so
@@ -322,7 +364,7 @@ def child_main(mode: str) -> None:
     # remaining BASELINE configs; every line above already landed, so a
     # timeout here only truncates the tail
     for phase in (bench_cifar, bench_deconv_ae, bench_kohonen,
-                  bench_mnist_wallclock):
+                  bench_mnist_wallclock, bench_transformer):
         try:
             phase()
         except Exception as exc:  # noqa: BLE001 — keep earlier results

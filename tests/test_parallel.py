@@ -239,10 +239,13 @@ def test_scan_epoch_midpass_entry_falls_back(cpu_devices):
         if loader.last_minibatch:
             break
         loader.run()
-    # remainder actually trained (params moved), metrics published sanely
+    # the WHOLE remainder trained (3 of 5 minibatches = 120 samples),
+    # not just the first fallback minibatch (regression: _acc was
+    # misused as the scan-in-flight marker and re-routed minibatch 2+
+    # back into the no-op scan path)
     after = np.asarray(jax.tree.leaves(step._params)[0])
     assert not np.array_equal(before, after)
-    assert step.minibatch_size > 0
+    assert step.minibatch_size == 120, step.minibatch_size
     assert step.loss > 0.0
 
 

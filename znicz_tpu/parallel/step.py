@@ -107,6 +107,7 @@ class FusedTrainStep(Unit):
         self._train_fn_idx = None
         self._eval_fn_idx = None
         self._scan_idx_fns = {}   # "train"/"eval" -> class-pass scan fn
+        self._scan_in_flight = False  # current class pass was scan-dispatched
         self._scan_fn = None      # lazily-built K-step lax.scan variant
         self._hyper_cache = None  # (signature, device pytree)
         self._acc = None          # device-side metric sums (deferred mode)
@@ -467,11 +468,12 @@ class FusedTrainStep(Unit):
         loader = self.loader
         if self._dataset_dev is not None and self._scan_idx_fns and \
                 (int(loader.minibatch_offset) == 0 or
-                 self._acc is not None):
+                 self._scan_in_flight):
             self._run_scanned_class(loader)
             return
         # (a class pass entered MID-WAY — restored loader state — falls
-        # through to the per-minibatch path for the remainder)
+        # through to the per-minibatch path for the remainder; _acc is
+        # NOT a valid in-flight marker because that path sets it too)
         mask = loader.minibatch_indices.mem >= 0
         if self._dataset_dev is not None:
             # index-fed hot path: dataset already on HBM
@@ -520,9 +522,11 @@ class FusedTrainStep(Unit):
                 metrics = self._scan_idx_fns["eval"](
                     self._params, data, labels, idxs, ms)
             self._acc = metrics
+            self._scan_in_flight = True
         if loader.last_minibatch:
             self._publish(jax.device_get(self._acc))
             self._acc = None
+            self._scan_in_flight = False
         else:
             self.n_err = 0
             self.mse = 0.0
