@@ -286,3 +286,28 @@ def test_ensemble_committee(tmp_path):
     data = loader.original_data.map_read()[:8]
     assert ens.predict_classes(data).shape == (8,)
     assert ens.predict_mean(data).shape[0] == 8
+
+
+def test_cli_ensemble_train(tmp_path, monkeypatch):
+    """--ensemble-train N runs N seeded members and writes the summary
+    JSON (reference: veles --ensemble-train)."""
+    wf = tmp_path / "wine_ens.py"
+    wf.write_text(WINE_WORKFLOW)
+    monkeypatch.chdir(tmp_path)
+    rc = cli_main([str(wf), "--ensemble-train", "3", "-d", "tpu",
+                   "--random-seed", "7"])
+    assert rc == 0
+    out = json.loads((tmp_path / "ensemble_wine.json").read_text())
+    assert out["n_members"] == 3
+    assert len(out["members"]) == 3
+    assert len({m["seed"] for m in out["members"]}) == 3
+    assert out["best"] <= out["mean"]
+
+
+def test_cli_ensemble_train_rejects_bad_usage(tmp_path, monkeypatch):
+    wf = tmp_path / "wine_ens2.py"
+    wf.write_text(WINE_WORKFLOW)
+    monkeypatch.chdir(tmp_path)
+    assert cli_main([str(wf), "--ensemble-train", "0", "-d", "tpu"]) == 2
+    assert cli_main([str(wf), "--ensemble-train", "2", "-d", "tpu",
+                     "--publish", "markdown"]) == 2

@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="config override, applied after config files")
     p.add_argument("--optimize", type=int, default=None, metavar="GENS",
                    help="genetic hyperparameter search over Tune() leaves")
+    p.add_argument("--ensemble-train", type=int, default=None,
+                   metavar="N", help="train N seeded members of the "
+                   "workflow and write an ensemble summary JSON "
+                   "(reference: --ensemble-train)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
     p.add_argument("--publish", default=None, metavar="BACKEND",
@@ -92,6 +96,28 @@ def main(argv=None) -> int:
         path = path.removeprefix("root.")
         set_by_path(root, path, _parse_value(value))
     module = load_workflow_module(args.workflow)
+    if args.ensemble_train is not None:
+        import json
+
+        if args.ensemble_train <= 0:
+            print("--ensemble-train needs N >= 1", file=sys.stderr)
+            return 2
+        if args.publish or args.snapshot or args.profile:
+            print("--ensemble-train cannot be combined with --publish/"
+                  "-w/--profile (members are independent runs)",
+                  file=sys.stderr)
+            return 2
+        from znicz_tpu.utils.ensemble import train_members_from_module
+
+        summary = train_members_from_module(
+            module, args.ensemble_train, args.random_seed,
+            lambda: Launcher(device=make_device(args.device),
+                             stealth=args.stealth))
+        out = f"ensemble_{summary['workflow'].lower()}.json"
+        with open(out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"ensemble summary -> {out}")
+        return 0
     launcher = Launcher(device=make_device(args.device),
                         snapshot=args.snapshot, stealth=args.stealth,
                         profile_dir=args.profile)

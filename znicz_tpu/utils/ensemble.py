@@ -22,6 +22,31 @@ from znicz_tpu.core.logger import Logger
 from znicz_tpu.loader.base import VALID
 
 
+def train_members_from_module(module, n_members: int, base_seed: int,
+                              make_launcher: Callable) -> dict:
+    """CLI ``--ensemble-train`` core: N seeded runs of a ``run(load,
+    main)`` workflow module; returns the summary dict the CLI writes.
+    Shared with :class:`Ensemble` semantics (prng.seed_all(base+i) per
+    member, Decision best metric collected)."""
+    members = []
+    name = None
+    for i in range(n_members):
+        seed = base_seed + i
+        prng.seed_all(seed)
+        launcher = make_launcher()
+        module.run(launcher.load, launcher.main)
+        dec = launcher.workflow.decision
+        name = launcher.workflow.name
+        members.append({"member": i, "seed": seed,
+                        "best_metric": dec.best_metric,
+                        "best_epoch": dec.best_epoch,
+                        "history": dec.metrics_history})
+    return {"workflow": name, "n_members": n_members,
+            "best": min(m["best_metric"] for m in members),
+            "mean": sum(m["best_metric"] for m in members) / len(members),
+            "members": members}
+
+
 class Ensemble(Logger):
     """Train + evaluate a committee of identically-built workflows."""
 
