@@ -289,3 +289,32 @@ def test_lr_schedule_no_recompile(cpu_devices):
     w.loader.run()
     w.step.run()
     assert w.step._train_fn._cache_size() == compiled
+
+
+def test_fused_step_bf16_compute_tracks_f32():
+    """Force the bf16 compute path (dead on CPU by default) through a
+    whole training run: losses track the f32 run loosely, params stay
+    f32, and every unit's xla_apply survives bf16 inputs.  Uses a conv
+    stack so conv/pool/LRN/dropout all see bf16."""
+    import jax.numpy as jnp
+    from znicz_tpu.models.mnist_conv import build
+
+    losses = {}
+    for name, cdt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        prng.seed_all(123)
+        w = build(max_epochs=2, minibatch_size=50, n_train=200, n_valid=50,
+                  loader_name="synthetic_image")
+        w.step.compute_dtype = cdt
+        w.initialize(device=TPUDevice())
+        w.run()
+        losses[name] = [h["metric_train"] for h in
+                        w.decision.metrics_history]
+        for leaf in jax.tree.leaves(w.step._params):
+            assert leaf.dtype == jnp.float32, (name, leaf.dtype)
+    assert len(losses["bf16"]) == len(losses["f32"])
+    # bf16 rounding makes trajectories diverge step by step; the run must
+    # still LEARN the same problem: final-epoch train errors in the same
+    # ballpark as the f32 oracle (identical data + init)
+    f32_final = losses["f32"][-1]
+    bf16_final = losses["bf16"][-1]
+    assert bf16_final <= max(1.5 * f32_final, f32_final + 10), losses

@@ -102,9 +102,10 @@ def main(argv=None) -> int:
         if args.ensemble_train <= 0:
             print("--ensemble-train needs N >= 1", file=sys.stderr)
             return 2
-        if args.publish or args.snapshot or args.profile:
+        if args.publish or args.snapshot or args.profile or \
+                args.optimize is not None:
             print("--ensemble-train cannot be combined with --publish/"
-                  "-w/--profile (members are independent runs)",
+                  "-w/--profile/--optimize (members are independent runs)",
                   file=sys.stderr)
             return 2
         from znicz_tpu.utils.ensemble import train_members_from_module
@@ -113,7 +114,12 @@ def main(argv=None) -> int:
             module, args.ensemble_train, args.random_seed,
             lambda: Launcher(device=make_device(args.device),
                              stealth=args.stealth))
-        out = f"ensemble_{summary['workflow'].lower()}.json"
+        # workflow display names are free text — keep the path safe
+        import re
+
+        slug = re.sub(r"[^a-z0-9_.-]+", "_",
+                      str(summary["workflow"]).lower()) or "workflow"
+        out = f"ensemble_{slug}.json"
         with open(out, "w") as f:
             json.dump(summary, f, indent=1)
         print(f"ensemble summary -> {out}")

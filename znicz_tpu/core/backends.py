@@ -27,6 +27,19 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 
 
+def resolve_compute_dtype(platform: str, precision: str | None = None):
+    """THE precision policy: bf16 on any accelerator platform when
+    ``precision`` (default ``root.common.engine.precision``) is
+    "bfloat16"; f32 on CPU regardless, preserving oracle numerics.  The
+    sandbox TPU reports platform "axon", not "tpu" — a literal match
+    here once left the whole framework silently in f32.  Shared by
+    ``TPUDevice.compute_dtype`` and the SPMD transformer stack."""
+    import jax.numpy as jnp
+    precision = precision or root.common.engine.get("precision", "bfloat16")
+    return jnp.bfloat16 if (precision == "bfloat16"
+                            and platform != "cpu") else jnp.float32
+
+
 class Device(Logger):
     """Base device."""
 
@@ -73,9 +86,7 @@ class TPUDevice(Device):
 
     @property
     def compute_dtype(self):
-        import jax.numpy as jnp
-        return jnp.bfloat16 if (self.precision == "bfloat16"
-                                and self.platform == "tpu") else jnp.float32
+        return resolve_compute_dtype(self.platform, self.precision)
 
     def put(self, host_array: np.ndarray) -> jax.Array:
         # device_put transfers asynchronously and reads the source buffer

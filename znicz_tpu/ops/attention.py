@@ -35,9 +35,18 @@ def masked_scores(xp, q, k, causal: bool, q_offset=0, k_offset=0):
     """Scaled q·kᵀ scores ``(b, h, tq, tk)`` with optional causal masking;
     ``*_offset`` give global positions when q/k are sequence blocks — the
     ONE definition of the mask convention, shared by dense attention and
-    the ring variant (znicz_tpu.parallel.ring_attention)."""
+    the ring variant (znicz_tpu.parallel.ring_attention).
+
+    The product accumulates in f32 even for bf16 inputs (matmul inputs
+    stay bf16 on the MXU; only the accumulator widens — the same rule as
+    the Pallas flash kernel, so the auto-selected paths agree)."""
     dh = q.shape[-1]
-    s = xp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(dh).astype(q.dtype)
+    try:
+        s = xp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=xp.float32)
+    except TypeError:      # numpy has no accumulator-dtype control
+        s = xp.einsum("bqhd,bkhd->bhqk", q, k)
+    s = s / np.sqrt(dh).astype(s.dtype)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         qpos = xp.arange(tq)[:, None] + q_offset
@@ -51,7 +60,8 @@ def attention(xp, q, k, v, causal: bool = False):
     """Scaled-dot-product attention over per-head tensors
     ``(b, t, h, dh)``."""
     p = softmax(xp, masked_scores(xp, q, k, causal))
-    return xp.einsum("bhqk,bkhd->bqhd", p, v)
+    # probabilities ride the MXU at the value dtype (flash-kernel rule)
+    return xp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
 def mha_forward(xp, x, params: dict, n_heads: int, causal: bool = False,

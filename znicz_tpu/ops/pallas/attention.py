@@ -1,0 +1,217 @@
+"""Flash attention as a Pallas kernel — the hot-op kernel for the
+long-context stack (TPU-native extension; the reference predates
+transformers, SURVEY.md §6.7, but its identity — a hand-written kernel
+for every op family's hot path — is matched here for attention).
+
+Row-block formulation: the grid walks ``(batch*heads, q_blocks)``; each
+step holds one q block plus the full K/V for that head in VMEM and
+computes its softmax row exactly — the ``(t, t)`` score matrix never
+touches HBM (XLA's dense path materializes it twice per layer per step:
+~1 GB/layer at b=8, h=8, t=2048, f32).  The saved residual is the
+logsumexp row ``lse`` (one f32 per query), from which the backward kernel
+reconstructs the probabilities: ``p = exp(s·scale - lse)``.
+
+VMEM budget per grid step is O(block_q·t + t·dh) ≈ 1.5 MB at t=2048 —
+fine through t≈8k.  Beyond that the sequence axis should be sharded (ring
+attention, znicz_tpu/parallel/ring_attention.py); the two compose: the
+ring rotates K/V blocks over ICI while each local block uses dense math,
+so per-shard t stays in this kernel's range.
+
+Backward follows the standard flash recipe in one grid pass: dq per
+q block; dk/dv accumulated across q blocks into a revisited output block
+(Pallas TPU grids execute sequentially, so accumulation over the minor
+grid axis is sound).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _mask_scores(s, causal: bool, iq: int, block_q: int):
+    """Apply the causal mask to one q block's score rows ``(bq, t)``."""
+    if not causal:
+        return s
+    bq, t = s.shape
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, t), 0) + iq * block_q
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, t), 1)
+    return jnp.where(kpos > qpos, jnp.float32(-1e30), s)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
+                sm_scale: float, block_q: int):
+    iq = pl.program_id(1)
+    q = q_ref[0]                                       # (bq, dh)
+    k = k_ref[0]                                       # (t, dh)
+    v = v_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    s = _mask_scores(s, causal, iq, block_q)
+    m = s.max(axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=1, keepdims=True)
+    # p rides the MXU at the input dtype (bf16 in production); the
+    # accumulator and the 1/l normalization stay f32
+    o = jnp.dot(p.astype(v.dtype), v,
+                preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, *, causal: bool, sm_scale: float,
+                block_q: int):
+    iq = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    q = q_ref[0]                                       # (bq, dh)
+    k = k_ref[0]                                       # (t, dh)
+    v = v_ref[0]
+    lse = lse_ref[0][:, None]                          # (bq, 1)
+    delta = delta_ref[0][:, None]                      # (bq, 1)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    s = _mask_scores(s, causal, iq, block_q)
+    p = jnp.exp(s - lse)                               # (bq, t)
+    # dv += pᵀ @ do (p cast to the MXU input dtype; accumulate f32)
+    dv_ref[0] += jax.lax.dot_general(
+        p.astype(v.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    # ds = p ⊙ (do @ vᵀ − Δ), already includes the softmax jacobian
+    dp = jax.lax.dot_general(do_ref[0], v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * sm_scale
+    dsc = ds.astype(q.dtype)
+    dq_ref[0] = jnp.dot(dsc, k,
+                        preferred_element_type=jnp.float32
+                        ).astype(dq_ref.dtype)
+    # dk += dsᵀ @ q
+    dk_ref[0] += jax.lax.dot_general(
+        dsc, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def _pick_block_q(t: int) -> int:
+    # 128 rows already fill the MXU's systolic dimension; larger q blocks
+    # only grow the (block_q, t) score temporaries that dominate the
+    # BACKWARD kernel's VMEM working set
+    return 128 if t % 128 == 0 else 0
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal: bool, interpret: bool):
+    o, _ = _flash_fwd(q, k, v, causal, interpret)
+    return o
+
+
+def _call_fwd(q, k, v, causal, interpret):
+    bh, t, dh = q.shape
+    block_q = _pick_block_q(t)
+    kern = partial(_fwd_kernel, causal=causal,
+                   sm_scale=1.0 / float(np.sqrt(dh)), block_q=block_q)
+    blk = lambda shape: pl.BlockSpec(                  # noqa: E731
+        shape, lambda i, j: (i,) + (0,) * (len(shape) - 1),
+        memory_space=pltpu.VMEM)
+    qspec = pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, t // block_q),
+        in_specs=[qspec, blk((1, t, dh)), blk((1, t, dh))],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    o, lse = _call_fwd(q, k, v, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, interpret, res, do):
+    q, k, v, o, lse = res
+    bh, t, dh = q.shape
+    block_q = _pick_block_q(t)
+    # Δ = rowsum(do ⊙ o) — the lse-side term of the softmax jacobian
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    kern = partial(_bwd_kernel, causal=causal,
+                   sm_scale=1.0 / float(np.sqrt(dh)), block_q=block_q)
+    full = lambda shape: pl.BlockSpec(                 # noqa: E731
+        shape, lambda i, j: (i,) + (0,) * (len(shape) - 1),
+        memory_space=pltpu.VMEM)
+    qblk3 = lambda: pl.BlockSpec((1, block_q, dh),     # noqa: E731
+                                 lambda i, j: (i, j, 0),
+                                 memory_space=pltpu.VMEM)
+    qblk2 = lambda: pl.BlockSpec((1, block_q),         # noqa: E731
+                                 lambda i, j: (i, j),
+                                 memory_space=pltpu.VMEM)
+    dq, dk, dv = pl.pallas_call(
+        kern,
+        grid=(bh, t // block_q),
+        in_specs=[qblk3(), full((1, t, dh)), full((1, t, dh)),
+                  qblk3(), qblk2(), qblk2()],
+        # dk/dv revisit the same (bh)-indexed block across the q axis —
+        # sequential grid makes the += accumulation exact
+        out_specs=[qblk3(), full((1, t, dh)), full((1, t, dh))],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def supported(t: int, dh: int) -> bool:
+    """Shapes this kernel handles: q-blockable time axis, lane-sized head
+    dim, and a VMEM budget that must cover the BACKWARD kernel (the one
+    actually run under value_and_grad): full K/V plus f32 dk/dv
+    accumulator blocks plus the three (block_q, t) f32 score temporaries
+    (p, dp, ds)."""
+    bq = _pick_block_q(t)
+    if bq == 0 or dh % 64 != 0:
+        return False
+    vmem = 4 * t * dh * 4 + 3 * bq * t * 4
+    return vmem <= 10 * 1024 * 1024
+
+
+def flash_attention(q, k, v, causal: bool = False, *,
+                    interpret: bool = False):
+    """Fused attention over per-head tensors ``(b, t, h, dh)`` — same
+    contract as ops.attention.attention (``softmax(q·kᵀ/√dh)·v``),
+    differentiable via the flash backward kernels."""
+    b, t, h, dh = q.shape
+    if not supported(t, dh):
+        raise ValueError(
+            f"flash_attention needs t divisible by a 128/256/512 q-block, "
+            f"dh a multiple of 64, and K/V within the VMEM budget; got "
+            f"t={t}, dh={dh} — gate call sites on "
+            f"ops.pallas.attention.supported() or use the dense path")
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, -1)  # noqa: E731
+    o = _flash(fold(q), fold(k), fold(v), causal, interpret)
+    return o.reshape(b, h, t, dh).transpose(0, 2, 1, 3)

@@ -33,18 +33,27 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     from znicz_tpu.ops.attention import masked_scores
 
     def scores(k_blk, blk_idx):
+        # masked_scores accumulates f32 over bf16 matmul inputs (MXU
+        # fast path); K/V rotate in their input dtype so ICI traffic
+        # stays bf16-sized
         return masked_scores(jnp, q, k_blk, causal,
                              q_offset=my_idx * t_loc,
                              k_offset=blk_idx * t_loc)
 
     def step(carry, _):
         o, m, l, k_blk, v_blk, blk_idx = carry
-        s = scores(k_blk, blk_idx)
+        # online-softmax state (o, m, l) accumulates in f32 even when
+        # q/k/v are bf16 — the exp/rescale chain loses digits fast in
+        # half precision (standard flash-attention accumulator rule)
+        s = scores(k_blk, blk_idx).astype(jnp.float32)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        # p rides the MXU at the value dtype; accumulation stays f32
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         # rotate: after this step we hold the block of (blk_idx - 1) % n
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -55,13 +64,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     # loop-updated values (shard_map scan vma rule); deriving them from q
     # inherits whatever axes q varies over (seq here, plus data/model when
     # composed with dp/tp)
-    zeros_q = jnp.transpose(q, (0, 2, 1, 3)) * 0.0     # (b, h, t_loc, dh)
-    o0 = zeros_q
+    zeros_q = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32) * 0.0
+    o0 = zeros_q                                       # (b, h, t_loc, dh)
     m0 = zeros_q[..., 0] - jnp.inf
     l0 = zeros_q[..., 0]
     (o, m, l, _, _, _), _ = lax.scan(
         step, (o0, m0, l0, k, v, my_idx), None, length=axis_size)
-    out = o / l[..., None]
+    out = (o / l[..., None]).astype(q.dtype)
     return jnp.transpose(out, (0, 2, 1, 3))  # (b, t_loc, h, dh)
 
 

@@ -37,9 +37,38 @@ from znicz_tpu.parallel import tp
 
 
 def _layer_norm(x, g, b, eps=1e-5):
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) / jnp.sqrt(var + eps) * g + b
+    # stats in f32 regardless of the compute dtype (bf16 mean/var loses
+    # ~3 decimal digits); the normalized result returns to x.dtype so the
+    # surrounding matmuls stay on the MXU's bf16 path
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = ((xf - mu) / jnp.sqrt(var + eps)).astype(x.dtype)
+    return y * g + b
+
+
+def _flash_eligible(mesh: Mesh) -> bool:
+    """Use the Pallas flash kernel when the seq axis is unsharded (the
+    ring handles sharded time) on a TPU-family backend (the sandbox chip
+    reports platform ``axon``); per-shape limits are checked at trace
+    time by ops.pallas.attention.supported.
+    ``root.common.engine.flash_attention`` (default True) turns it off."""
+    from znicz_tpu.core.config import root
+    if not bool(root.common.engine.get("flash_attention", True)):
+        return False
+    if mesh.shape.get("seq", 1) != 1:
+        return False
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def resolve_compute_dtype(compute_dtype=None):
+    """Explicit dtype wins; None defers to the framework-wide precision
+    policy (core.backends.resolve_compute_dtype) for this process's
+    default backend."""
+    if compute_dtype is not None:
+        return compute_dtype
+    from znicz_tpu.core.backends import resolve_compute_dtype as policy
+    return policy(jax.default_backend())
 
 
 # -- dp x sp x tp flagship --------------------------------------------------
@@ -78,9 +107,12 @@ def param_specs(n_layers: int):
     return {"emb": P(), "head": P(), "blocks": [dict(blk)] * n_layers}
 
 
-def _block(x, p, heads_local: int, causal: bool):
+def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False):
     """One transformer block on local shards: ring attention (seq axis)
-    with tp-sharded heads, then Megatron MLP (model axis)."""
+    with tp-sharded heads, then Megatron MLP (model axis).  With the seq
+    axis unsharded, ``use_flash`` swaps the attention core for the Pallas
+    flash kernel (ops/pallas/attention.py) — same math, no (t, t) score
+    matrix in HBM."""
     h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
     b, t_loc, _ = h.shape
 
@@ -89,7 +121,11 @@ def _block(x, p, heads_local: int, causal: bool):
         return y.reshape(b, t_loc, heads_local, -1)
 
     q, k, v = heads_of(p["wq"]), heads_of(p["wk"]), heads_of(p["wv"])
-    o = ring_attention(q, k, v, "seq", causal=causal)
+    from znicz_tpu.ops.pallas import attention as pattn
+    if use_flash and pattn.supported(t_loc, q.shape[-1]):
+        o = pattn.flash_attention(q, k, v, causal=causal)
+    else:
+        o = ring_attention(q, k, v, "seq", causal=causal)
     o = o.reshape(b, t_loc, -1)                      # (b, t_loc, d_local)
     x = x + tp.row_parallel(o, p["wo"], None, "model")
     m = _layer_norm(x, p["ln2_g"], p["ln2_b"])
@@ -99,11 +135,18 @@ def _block(x, p, heads_local: int, causal: bool):
 
 
 def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
-                    vocab: int, lr: float = 0.1, causal: bool = True):
+                    vocab: int, lr: float = 0.1, causal: bool = True,
+                    compute_dtype=None):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
+
+    Mixed precision follows the FusedTrainStep recipe: master params and
+    the SGD update stay f32; the forward casts params + activations to
+    ``compute_dtype`` (bf16 on accelerators, see
+    :func:`resolve_compute_dtype`), and the loss/log-softmax runs f32.
+    AD transposes the casts, so gradients land f32 on the masters.
     """
     tp_size = mesh.shape["model"]
     if heads % tp_size or d % tp_size or ff % tp_size:
@@ -111,13 +154,16 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                          f"d={d} and ff={ff}")
     heads_local = heads // tp_size
     specs = param_specs(n_layers)
+    cdt = resolve_compute_dtype(compute_dtype)
+    use_flash = _flash_eligible(mesh)
 
     def local_step(params, tokens, labels):
         def loss_fn(ps):
+            ps = jax.tree.map(lambda w: w.astype(cdt), ps)
             x = ps["emb"][tokens]                     # (b_l, t_l, d)
             for p in ps["blocks"]:
-                x = _block(x, p, heads_local, causal)
-            logits = x @ ps["head"]
+                x = _block(x, p, heads_local, causal, use_flash)
+            logits = (x @ ps["head"]).astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, axis=-1)
             picked = jnp.take_along_axis(
                 logp, labels[..., None], axis=-1)[..., 0]

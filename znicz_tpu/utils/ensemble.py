@@ -41,9 +41,14 @@ def train_members_from_module(module, n_members: int, base_seed: int,
                         "best_metric": dec.best_metric,
                         "best_epoch": dec.best_epoch,
                         "history": dec.metrics_history})
+    # a member whose Decision never finished a train epoch reports
+    # best_metric None — aggregate over the rest instead of crashing
+    # after every member already trained
+    scored = [m["best_metric"] for m in members
+              if m["best_metric"] is not None]
     return {"workflow": name, "n_members": n_members,
-            "best": min(m["best_metric"] for m in members),
-            "mean": sum(m["best_metric"] for m in members) / len(members),
+            "best": min(scored) if scored else None,
+            "mean": sum(scored) / len(scored) if scored else None,
             "members": members}
 
 
