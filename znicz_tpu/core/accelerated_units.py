@@ -100,3 +100,62 @@ class AcceleratedUnit(Unit):
 class AcceleratedWorkflow(Workflow):
     """Workflow whose initialize injects a Device into accelerated children
     (reference: veles/accelerated_units.py :: AcceleratedWorkflow)."""
+
+
+class DeviceBenchmark:
+    """Measure the device's achieved dense-GEMM throughput (reference row:
+    veles/accelerated_units.py :: DeviceBenchmark — there it ranked device
+    speed for master scheduling; here it validates the live chip against
+    the analytic peak table MFU reporting divides by, utils/flops.py).
+
+    ``run()`` times ``reps`` chained ``size x size`` matmuls in the
+    device's compute dtype (bf16 on accelerators) and returns achieved
+    GFLOP/s plus fraction-of-peak when the chip generation is known.
+    """
+
+    def __init__(self, size: int = 2048, reps: int = 8) -> None:
+        self.size = int(size)
+        self.reps = int(reps)
+
+    def run(self, device=None) -> dict:
+        import time
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from znicz_tpu.core.backends import TPUDevice
+        from znicz_tpu.utils import flops as flops_mod
+
+        device = device or TPUDevice()
+        dtype = getattr(device, "compute_dtype", jnp.float32)
+        n = self.size
+        a = jnp.asarray(
+            np.random.default_rng(0).normal(size=(n, n)), dtype)
+
+        def chain(x):
+            for _ in range(self.reps):
+                # the cheap epilogue add keeps the chain un-foldable
+                # without charging VPU transcendental work against the
+                # MXU peak the result is compared to
+                x = x @ a + jnp.asarray(0.5, dtype)
+            return x
+
+        fn = jax.jit(chain)
+        x0 = jnp.eye(n, dtype=dtype)
+        jax.block_until_ready(fn(x0))            # compile + warm
+        iters = 10                               # amortize dispatch + fence
+        t0 = time.perf_counter()
+        out = x0
+        for _ in range(iters):
+            out = fn(out)
+        float(jnp.float32(out[0, 0]))            # d2h fence (axon-safe)
+        dt = time.perf_counter() - t0
+        gflops = 2.0 * n * n * n * self.reps * iters / dt / 1e9
+        peak = flops_mod.peak_flops()
+        result = {"size": n, "reps": self.reps, "dtype": str(dtype.__name__),
+                  "seconds": dt, "gflops": round(gflops, 1)}
+        if peak and jax.default_backend() != "cpu":
+            # the peak table is TPU generations — a CPU run reporting a
+            # fraction of TPU peak would be noise, not a measurement
+            result["fraction_of_peak"] = round(gflops * 1e9 / peak, 4)
+        return result

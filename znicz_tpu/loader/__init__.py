@@ -21,9 +21,12 @@ from znicz_tpu.loader import pickles   # noqa: F401  (registry population)
 from znicz_tpu.loader.mnist import MnistLoader
 from znicz_tpu.loader.image import FileImageLoader, FullBatchImageLoader
 from znicz_tpu.loader.pickles import PicklesImageLoader
+from znicz_tpu.loader.interactive import InteractiveLoader
+from znicz_tpu.loader.restful import PredictionServer
 
 __all__ = ["Loader", "FullBatchLoader", "FullBatchLoaderMSE",
            "MnistLoader", "FileImageLoader", "FullBatchImageLoader",
-           "PicklesImageLoader", "NORMALIZER_REGISTRY", "normalizer_factory",
+           "PicklesImageLoader", "InteractiveLoader", "PredictionServer",
+           "NORMALIZER_REGISTRY", "normalizer_factory",
            "TEST", "VALID", "TRAIN", "CLASS_NAMES",
            "register_loader", "get_loader"]

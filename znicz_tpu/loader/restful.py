@@ -1,0 +1,107 @@
+"""RESTful inference serving — rebuild of the reference's
+``veles/loader/restful.py`` row (SURVEY.md §3.3 Loaders): an HTTP
+endpoint that feeds request samples through a trained forward chain and
+returns predictions.
+
+TPU-native design: the server wraps an exported forward package
+(utils/export.py :: ExportedForward — the libZnicz-equivalent inference
+runtime, one jitted function) or any ``array -> array`` callable, NOT a
+live training workflow; serving and training stay decoupled the way the
+reference decoupled libVeles inference from the master process.  Requests
+are padded to the package's compiled batch and answered synchronously.
+
+    POST /predict  {"input": [[...], ...]}  ->  {"output": [[...], ...]}
+    GET  /         -> model metadata JSON
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from znicz_tpu.core.logger import Logger
+
+
+class PredictionServer(Logger):
+    """Serve ``model(x) -> y`` over HTTP on localhost.
+
+    ``model``: an ``ExportedForward``, a path to a forward package
+    (.npz, loaded via utils.export.ExportedForward), or any callable
+    taking a float32 batch array.  ``port=0`` picks a free port.
+    """
+
+    def __init__(self, model, port: int = 0, max_batch: int = 1024) -> None:
+        super().__init__()
+        if isinstance(model, str):
+            from znicz_tpu.utils.export import ExportedForward
+            model = ExportedForward(model)
+        self.model = model
+        self.port = int(port)
+        self.max_batch = int(max_batch)
+        self.meta = dict(getattr(model, "meta", {}) or {})
+        self.n_requests = 0
+        self._lock = threading.Lock()   # jit dispatch is not reentrant-safe
+        self._httpd = None
+        self._thread = None
+
+    def predict(self, batch) -> np.ndarray:
+        x = np.asarray(batch, np.float32)
+        if x.ndim == 1:
+            x = x[None]
+        if len(x) > self.max_batch:
+            raise ValueError(f"batch {len(x)} > max_batch {self.max_batch}")
+        with self._lock:
+            self.n_requests += 1
+            return np.asarray(self.model(x))
+
+    # -- HTTP ----------------------------------------------------------------
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(200, {"model": server.meta,
+                                  "n_requests": server.n_requests,
+                                  "max_batch": server.max_batch})
+
+            def do_POST(self):
+                if not self.path.startswith("/predict"):
+                    self._reply(404, {"error": "POST /predict"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n))
+                    out = server.predict(doc["input"])
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
+                self._reply(200, {"output": out.tolist()})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info(f"prediction server on http://127.0.0.1:{self.port}/")
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -61,10 +61,11 @@ def _flash_eligible(mesh: Mesh) -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def resolve_compute_dtype(compute_dtype=None):
+def _default_compute_dtype(compute_dtype=None):
     """Explicit dtype wins; None defers to the framework-wide precision
     policy (core.backends.resolve_compute_dtype) for this process's
-    default backend."""
+    default backend.  (Named differently from the backends policy on
+    purpose — its first argument is a dtype, not a platform string.)"""
     if compute_dtype is not None:
         return compute_dtype
     from znicz_tpu.core.backends import resolve_compute_dtype as policy
@@ -145,7 +146,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     Mixed precision follows the FusedTrainStep recipe: master params and
     the SGD update stay f32; the forward casts params + activations to
     ``compute_dtype`` (bf16 on accelerators, see
-    :func:`resolve_compute_dtype`), and the loss/log-softmax runs f32.
+    :func:`_default_compute_dtype`), and the loss/log-softmax runs f32.
     AD transposes the casts, so gradients land f32 on the masters.
     """
     tp_size = mesh.shape["model"]
@@ -154,7 +155,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                          f"d={d} and ff={ff}")
     heads_local = heads // tp_size
     specs = param_specs(n_layers)
-    cdt = resolve_compute_dtype(compute_dtype)
+    cdt = _default_compute_dtype(compute_dtype)
     use_flash = _flash_eligible(mesh)
 
     def local_step(params, tokens, labels):
