@@ -101,3 +101,53 @@ def test_dp_pp_ep_pipeline_step_learns(cpu_devices):
         params, loss = step(params, xs, ys)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_flash_step_matches_ring_composition(cpu_devices):
+    """The full composition — Pallas flash attention inside the
+    shard_map'd train step, through jit and AD — executes (interpret
+    mode) and trains identically to the ring/XLA attention path.
+
+    Runs on a SINGLETON mesh on purpose: the interpret path needs
+    ``check_vma=False`` (a Pallas HLO-interpreter limitation), under
+    which psum transposition gains extra reductions — harmless only when
+    every axis has size 1.  Multi-device semantics of the step itself are
+    covered by the ring-path tests; the flash kernel is per-shard-local
+    math.  t=128 exercises exactly one q block; dh=128 passes the flash
+    gate (guarded below against geometry drift silently degrading this
+    to ring-vs-ring)."""
+    from znicz_tpu.core.config import root
+
+    from znicz_tpu.ops.pallas.attention import supported
+
+    prng.seed_all(13)
+    gen = prng.get()
+    n_layers, d, heads, ff, vocab = 1, 256, 2, 64, 11
+    assert supported(128, d // heads)
+    params = tfm.init_params(gen, n_layers, d, heads, ff, vocab)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, vocab, (4, 128)).astype(np.int32)
+    labels = ((tokens + 1) % vocab).astype(np.int32)
+    mesh = make_mesh({"data": 1, "seq": 1, "model": 1})
+
+    losses = {}
+    for name, flags in (("ring", {"flash_attention": False}),
+                        ("flash", {"flash_attention": True,
+                                   "pallas_interpret": True})):
+        for key, val in flags.items():
+            setattr(root.common.engine, key, val)
+        try:
+            step, _ = tfm.make_train_step(mesh, n_layers, d, heads, ff,
+                                          vocab, lr=0.1)
+            p = {k: (v if not isinstance(v, list) else
+                     [dict(b) for b in v]) for k, v in params.items()}
+            run = []
+            for _ in range(3):
+                p, loss = step(p, tokens, labels)
+                run.append(float(loss))
+            losses[name] = run
+        finally:
+            root.common.engine.flash_attention = True
+            root.common.engine.pallas_interpret = False
+    np.testing.assert_allclose(losses["flash"], losses["ring"],
+                               rtol=1e-4, atol=1e-5)

@@ -116,6 +116,17 @@ def _flash(q, k, v, causal: bool, interpret: bool):
     return o
 
 
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes: under
+    shard_map with vma checking, pallas_call outputs must declare which
+    mesh axes they vary over — same set as the operands."""
+    typeof = getattr(jax, "typeof", None)    # vma-era jax only
+    vma = getattr(typeof(like), "vma", None) if typeof else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _call_fwd(q, k, v, causal, interpret):
     bh, t, dh = q.shape
     block_q = _pick_block_q(t)
@@ -137,8 +148,8 @@ def _call_fwd(q, k, v, causal, interpret):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            _out_struct((bh, t, dh), q.dtype, q),
+            _out_struct((bh, t), jnp.float32, q),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -175,9 +186,9 @@ def _flash_bwd(causal, interpret, res, do):
         # sequential grid makes the += accumulation exact
         out_specs=[qblk3(), full((1, t, dh)), full((1, t, dh))],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
-            jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
+            _out_struct((bh, t, dh), q.dtype, q),
+            _out_struct((bh, t, dh), jnp.float32, q),
+            _out_struct((bh, t, dh), jnp.float32, q),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)

@@ -47,17 +47,23 @@ def _layer_norm(x, g, b, eps=1e-5):
     return y * g + b
 
 
-def _flash_eligible(mesh: Mesh) -> bool:
+def _flash_eligible(mesh: Mesh, interpret: bool) -> bool:
     """Use the Pallas flash kernel when the seq axis is unsharded (the
     ring handles sharded time) on a TPU-family backend (the sandbox chip
     reports platform ``axon``); per-shape limits are checked at trace
     time by ops.pallas.attention.supported.
-    ``root.common.engine.flash_attention`` (default True) turns it off."""
+    ``root.common.engine.flash_attention`` (default True) turns it off;
+    ``interpret`` (the pallas_interpret flag, captured once at step-build
+    time) forces it ON for the Pallas interpreter — but only on a
+    SINGLETON mesh, because interpret mode needs ``check_vma=False``
+    whose altered psum transposition is only harmless at axis size 1."""
     from znicz_tpu.core.config import root
     if not bool(root.common.engine.get("flash_attention", True)):
         return False
     if mesh.shape.get("seq", 1) != 1:
         return False
+    if interpret:
+        return all(s == 1 for s in mesh.shape.values())
     return jax.default_backend() in ("tpu", "axon")
 
 
@@ -108,12 +114,15 @@ def param_specs(n_layers: int):
     return {"emb": P(), "head": P(), "blocks": [dict(blk)] * n_layers}
 
 
-def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False):
+def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
+           interpret: bool = False):
     """One transformer block on local shards: ring attention (seq axis)
     with tp-sharded heads, then Megatron MLP (model axis).  With the seq
     axis unsharded, ``use_flash`` swaps the attention core for the Pallas
     flash kernel (ops/pallas/attention.py) — same math, no (t, t) score
-    matrix in HBM."""
+    matrix in HBM.  ``interpret`` is captured at step-build time along
+    with ``use_flash`` so one config snapshot governs all three
+    flash-related decisions (kernel choice, interpreter, vma mode)."""
     h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
     b, t_loc, _ = h.shape
 
@@ -124,7 +133,8 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False):
     q, k, v = heads_of(p["wq"]), heads_of(p["wk"]), heads_of(p["wv"])
     from znicz_tpu.ops.pallas import attention as pattn
     if use_flash and pattn.supported(t_loc, q.shape[-1]):
-        o = pattn.flash_attention(q, k, v, causal=causal)
+        o = pattn.flash_attention(q, k, v, causal=causal,
+                                  interpret=interpret)
     else:
         o = ring_attention(q, k, v, "seq", causal=causal)
     o = o.reshape(b, t_loc, -1)                      # (b, t_loc, d_local)
@@ -156,14 +166,16 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     heads_local = heads // tp_size
     specs = param_specs(n_layers)
     cdt = _default_compute_dtype(compute_dtype)
-    use_flash = _flash_eligible(mesh)
+    from znicz_tpu.core.config import root as root_cfg
+    interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
+    use_flash = _flash_eligible(mesh, interp)
 
     def local_step(params, tokens, labels):
         def loss_fn(ps):
             ps = jax.tree.map(lambda w: w.astype(cdt), ps)
             x = ps["emb"][tokens]                     # (b_l, t_l, d)
             for p in ps["blocks"]:
-                x = _block(x, p, heads_local, causal, use_flash)
+                x = _block(x, p, heads_local, causal, use_flash, interp)
             logits = (x @ ps["head"]).astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, axis=-1)
             picked = jnp.take_along_axis(
@@ -178,10 +190,23 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
             lambda w, g: w - lr * g / n_shards, params, grads)
         return new_params, loss / n_shards
 
+    kwargs = {}
+    if use_flash and interp:
+        # the Pallas HLO interpreter's internal dynamic_slices mix vma'd
+        # and unvaried operands, tripping shard_map's vma checker — a
+        # JAX-internal limitation of interpret mode only; the Mosaic
+        # path (real TPU) type-checks fine, so keep checking there.
+        # _flash_eligible only allows interpret-flash on a SINGLETON
+        # mesh, where the relaxed psum transposition is exact.  Older
+        # jax's fallback shard_map spells the flag check_rep
+        import inspect
+        flag = "check_vma" if "check_vma" in \
+            inspect.signature(shard_map).parameters else "check_rep"
+        kwargs[flag] = False
     step = shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, P("data", "seq"), P("data", "seq")),
-        out_specs=(specs, P()))
+        out_specs=(specs, P()), **kwargs)
     return jax.jit(step), specs
 
 
