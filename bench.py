@@ -282,29 +282,39 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
 
 def bench_kohonen(n_train=4000, minibatch=500, epochs=3):
     """BASELINE.md config 5: Kohonen SOM winner-take-all training.  The
-    SOM trainer is its own accelerated unit (not a FusedTrainStep), so
-    this measures the unit-graph hot loop end to end."""
+    SOM trainer is its own accelerated unit (not a FusedTrainStep); runs
+    in epoch-scan mode (one compiled dispatch per class pass), so this
+    measures the scanned unit-graph hot loop end to end."""
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
     from znicz_tpu.models.kohonen import build
 
     t0 = time.time()
-    # warm-up: one throwaway epoch compiles the SOM kernels (same shapes),
-    # matching the compile-then-time protocol of _throughput
-    prng.seed_all(7)
-    warm = build(max_epochs=1, shape=(16, 16), minibatch_size=minibatch,
-                 n_train=n_train, sample_shape=(16,), min_delta=0.0)
-    warm.initialize(device=TPUDevice())
-    warm.run()
-    prng.seed_all(7)
-    w = build(max_epochs=epochs, shape=(16, 16), minibatch_size=minibatch,
-              n_train=n_train, sample_shape=(16,), min_delta=0.0)
-    w.initialize(device=TPUDevice())
-    print(f"# kohonen: initialized+warmed in {time.time() - t0:.1f}s",
-          file=sys.stderr)
-    t0 = time.perf_counter()
-    w.run()
-    dt = time.perf_counter() - t0
+    prev_scan = root.common.engine.get("scan_epoch", False)
+    root.common.engine.scan_epoch = True
+    try:
+        # warm-up: one throwaway epoch compiles the SOM kernels (same
+        # shapes), matching the compile-then-time protocol of _throughput
+        prng.seed_all(7)
+        warm = build(max_epochs=1, shape=(16, 16), minibatch_size=minibatch,
+                     n_train=n_train, sample_shape=(16,), min_delta=0.0)
+        warm.initialize(device=TPUDevice())
+        warm.run()
+        prng.seed_all(7)
+        w = build(max_epochs=epochs, shape=(16, 16),
+                  minibatch_size=minibatch, n_train=n_train,
+                  sample_shape=(16,), min_delta=0.0)
+        w.initialize(device=TPUDevice())
+        print(f"# kohonen: initialized+warmed in {time.time() - t0:.1f}s",
+              file=sys.stderr)
+        t0 = time.perf_counter()
+        w.run()
+        # the run's last device work is async; fence on the weights read
+        w.trainer.weights.map_read()
+        dt = time.perf_counter() - t0
+    finally:
+        root.common.engine.scan_epoch = prev_scan
     _emit("kohonen_som256_train_samples_per_sec_per_chip",
           n_train * epochs / dt)
 

@@ -102,3 +102,60 @@ def test_rbm_workflow_reconstruction_improves():
     assert bool(dec.complete)
     hist = [h["metric_validation"] for h in dec.metrics_history]
     assert hist[-1] < hist[0], hist
+
+
+def test_kohonen_scan_epoch_matches_eager():
+    """Epoch-scan mode (one compiled dispatch per class pass) trains to
+    the same weights and reports the same |ΔW| trajectory as the
+    per-minibatch path — same seed, same data, same step order."""
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.models.kohonen import build
+
+    runs = {}
+    for mode in ("eager", "scan"):
+        prng.seed_all(77)
+        root.common.engine.scan_epoch = (mode == "scan")
+        try:
+            w = build(max_epochs=4, shape=(6, 6), minibatch_size=40,
+                      n_train=200, sample_shape=(3,), min_delta=0.0)
+            w.initialize(device=TPUDevice())
+            w.run()
+        finally:
+            root.common.engine.scan_epoch = False
+        runs[mode] = {
+            "weights": np.asarray(w.trainer.weights.map_read()).copy(),
+            "deltas": [h["metric_train"] for h in
+                       w.decision.metrics_history],
+        }
+        if mode == "scan":
+            assert w.trainer._scan_fn is not None   # mode actually on
+    np.testing.assert_allclose(runs["scan"]["weights"],
+                               runs["eager"]["weights"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(runs["scan"]["deltas"],
+                               runs["eager"]["deltas"], rtol=1e-4)
+
+
+def test_kohonen_scan_min_delta_still_stops():
+    """The Decision's |ΔW| convergence stop keeps working in scan mode
+    (the pre-pass weight snapshot keeps the metric honest)."""
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.models.kohonen import build
+
+    prng.seed_all(5)
+    root.common.engine.scan_epoch = True
+    try:
+        w = build(max_epochs=50, shape=(4, 4), minibatch_size=50,
+                  n_train=100, sample_shape=(2,), alpha=0.05,
+                  radius_decay=0.5, min_delta=0.2)
+        w.initialize(device=TPUDevice())
+        w.run()
+    finally:
+        root.common.engine.scan_epoch = False
+    # must stop on the delta criterion well before max_epochs, with a
+    # real (nonzero) first-epoch delta
+    hist = [h["metric_train"] for h in w.decision.metrics_history]
+    assert hist[0] > 0.01, hist
+    assert len(hist) < 50, len(hist)

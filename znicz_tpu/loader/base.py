@@ -50,6 +50,18 @@ def get_loader(name: str) -> type:
                        f"{sorted(LOADER_REGISTRY)}") from None
 
 
+def plan_device_arrays(plan: np.ndarray):
+    """Class plan -> device arrays for a scanned pass: ``(idxs, ms)``
+    with -1 padding clamped to row 0 and masked out.  Shared by the two
+    epoch-scan consumers (FusedTrainStep and KohonenTrainer) so their
+    plan conventions cannot drift."""
+    import jax.numpy as jnp
+
+    idxs = jnp.asarray(np.maximum(plan, 0).astype(np.int32))
+    ms = jnp.asarray(plan >= 0)
+    return idxs, ms
+
+
 class Loader(AcceleratedUnit):
     """Minibatch server over an abstract dataset."""
 

@@ -26,6 +26,9 @@ def build(max_epochs: int = 10, shape=(8, 8), minibatch_size: int = 50,
         n_valid=0, minibatch_size=minibatch_size, spread=3.0, noise=0.5)
     trainer = w.trainer = KohonenTrainer(
         w, shape=shape, alpha=alpha, radius_decay=radius_decay)
+    # enables epoch-scan mode (root.common.engine.scan_epoch): one
+    # compiled dispatch per class pass over the HBM-pinned dataset
+    trainer.loader = w.loader
     fwd = w.forward = KohonenForward(w, shape=shape)
     dec = w.decision = KohonenDecision(w, max_epochs=max_epochs,
                                        min_delta=min_delta)
@@ -41,7 +44,8 @@ def build(max_epochs: int = 10, shape=(8, 8), minibatch_size: int = 50,
     w.end_point.gate_block = ~dec.complete
 
     trainer.link_attrs(w.loader, ("input", "minibatch_data"),
-                       ("batch_size", "minibatch_size"), "epoch_number")
+                       ("batch_size", "minibatch_size"), "epoch_number",
+                       "epoch_ended")
     fwd.link_attrs(w.loader, ("input", "minibatch_data"),
                    ("batch_size", "minibatch_size"))
     fwd.link_attrs(trainer, "weights")

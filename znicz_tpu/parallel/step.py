@@ -509,9 +509,8 @@ class FusedTrainStep(Unit):
         metrics land at the last minibatch — the same "virtual minibatch"
         the Decision already sees in deferred mode."""
         if int(loader.minibatch_offset) == 0:
-            plan = loader.class_plan()
-            idxs = jnp.asarray(np.maximum(plan, 0).astype(np.int32))
-            ms = jnp.asarray(plan >= 0)
+            from znicz_tpu.loader.base import plan_device_arrays
+            idxs, ms = plan_device_arrays(loader.class_plan())
             data, labels = self._dataset_dev
             if int(loader.minibatch_class) == TRAIN:
                 self._params, self._key, metrics = \

@@ -60,18 +60,47 @@ class KohonenTrainer(KohonenBase):
         self.radius_min = float(radius_min)
         self.radius_decay = float(radius_decay)
         self.epoch_number = 0            # data-linked from the loader
+        self.epoch_ended = False         # data-linked from the loader
         self.winners = Array()
         self._coords_np = None
+        #: optional loader reference enabling epoch-scan mode: ONE
+        #: compiled lax.scan dispatch per class pass over the HBM-pinned
+        #: dataset, instead of one dispatch per minibatch (the same
+        #: design as FusedTrainStep epoch scanning; per-minibatch
+        #: dispatch latency dominates SOM steps).  Resolved from
+        #: ``root.common.engine.scan_epoch`` at xla_init when None.
+        self.loader = None
+        self.scan_epoch = None
+        self._scan_fn = None
+        self._dataset_dev = None
+        self._scan_in_flight = False  # current class pass scan-dispatched
+        #: weights as of the START of the current epoch (consumed by
+        #: KohonenDecision's |ΔW| metric — its own capture point runs
+        #: after this unit, which would miss the first minibatch's
+        #: movement, or in scan mode the whole pass)
+        self.epoch_start_weights = None
+        self._snap_epoch = None
+
+    @property
+    def _schedule_epoch(self) -> int:
+        """The epoch the CURRENT minibatch belongs to.  The loader
+        increments ``epoch_number`` while serving the last minibatch of
+        an epoch (before this unit runs on it), so the raw counter would
+        decay the schedule one minibatch early each epoch."""
+        e = int(self.epoch_number)
+        if bool(getattr(self, "epoch_ended", False)):
+            e = max(e - 1, 0)
+        return e
 
     # current schedule values (read by tests/plotters)
     @property
     def alpha(self) -> float:
-        return max(self.alpha0 * self.gradient_decay ** int(self.epoch_number),
+        return max(self.alpha0 * self.gradient_decay ** self._schedule_epoch,
                    self.alpha_min)
 
     @property
     def radius(self) -> float:
-        return max(self.radius0 * self.radius_decay ** int(self.epoch_number),
+        return max(self.radius0 * self.radius_decay ** self._schedule_epoch,
                    self.radius_min)
 
     def _common_init(self, **kwargs) -> None:
@@ -84,7 +113,15 @@ class KohonenTrainer(KohonenBase):
         self._coords_np = np.asarray(k_ops.grid_coords(np, self.sy, self.sx))
         self.init_array(self.input, self.weights, self.winners)
 
+    def _maybe_snapshot_epoch_start(self) -> None:
+        e = self._schedule_epoch
+        if self._snap_epoch != e:
+            self.epoch_start_weights = np.asarray(
+                self.weights.map_read()).copy()
+            self._snap_epoch = e
+
     def numpy_run(self) -> None:
+        self._maybe_snapshot_epoch_start()
         x = self._flat_input(self.input.mem)
         mask = self._mask(x.shape[0])
         new_w, idx = k_ops.update(np, x, self.weights.mem, self._coords_np,
@@ -122,8 +159,70 @@ class KohonenTrainer(KohonenBase):
                 return new_w, idx.astype(jnp.int32)
 
         self._xla_fn = jax.jit(fn)
+        self._maybe_enable_scan(fn)
+
+    def _maybe_enable_scan(self, step_fn) -> None:
+        """Pin the loader's full-batch dataset on device and compile the
+        per-class-pass scan (one dispatch per pass; class-plan padding
+        sits at the tail, so the per-step ``bs`` mask stays valid)."""
+        from znicz_tpu.core.config import root
+
+        if self.scan_epoch is None:
+            self.scan_epoch = bool(root.common.engine.get("scan_epoch",
+                                                          False))
+        loader = self.loader
+        data_arr = getattr(loader, "original_data", None)
+        if not self.scan_epoch or loader is None or not data_arr:
+            return
+        data = np.asarray(data_arr.mem, np.float32)
+        data = data.reshape(data.shape[0], -1)
+        limit = int(root.common.engine.get(
+            "dataset_on_device_max_bytes", 1 << 30))
+        if data.nbytes > limit:
+            return
+        self._dataset_dev = jnp.asarray(data)
+
+        def epoch_fn(w, idxs, ms, alpha, radius):
+            def body(w, inp):
+                idx, m = inp
+                new_w, _ = step_fn(self._dataset_dev[idx], w, alpha,
+                                   radius, m.sum())
+                return new_w, None
+            w, _ = jax.lax.scan(body, w, (idxs, ms))
+            return w
+
+        self._scan_fn = jax.jit(epoch_fn)
+        loader.capture_class_plan = True
+        # NOTE: the loader keeps filling minibatch_data — KohonenForward
+        # (winner maps / hits plotters) and the mid-pass-resume fallback
+        # below read it; SOM minibatches are small, so the per-step host
+        # fill is not the bottleneck the scan removes (dispatch latency)
 
     def xla_run(self) -> None:
+        if self._scan_fn is not None and \
+                (int(self.loader.minibatch_offset) == 0 or
+                 self._scan_in_flight):
+            # epoch-scan mode: dispatch the WHOLE class pass at its first
+            # minibatch; later minibatches of the pass are no-ops (the
+            # control loop still walks them — the loader serves cheaply).
+            # ``winners`` is not updated per minibatch here; winner maps
+            # come from KohonenForward as in the demo graph.
+            if int(self.loader.minibatch_offset) == 0:
+                from znicz_tpu.loader.base import plan_device_arrays
+                idxs, ms = plan_device_arrays(self.loader.class_plan())
+                self._maybe_snapshot_epoch_start()
+                self.weights.unmap()
+                new_w = self._scan_fn(self.weights.devmem, idxs, ms,
+                                      self.alpha, self.radius)
+                self.weights.set_devmem(new_w)
+                self._scan_in_flight = True
+            if self.loader.last_minibatch:
+                self._scan_in_flight = False
+            return
+        # per-minibatch path: also the fallback for a class pass entered
+        # MID-WAY (restored loader state after resume — same defense as
+        # FusedTrainStep.run)
+        self._maybe_snapshot_epoch_start()
         self.input.unmap()
         self.weights.unmap()
         x = self.input.devmem
@@ -193,7 +292,9 @@ class KohonenDecision(DecisionBase):
 
     def accumulate(self, cls: int) -> None:
         if self._epoch_start_w is None:
-            self._epoch_start_w = self.trainer.weights.map_read().copy()
+            pre = getattr(self.trainer, "epoch_start_weights", None)
+            self._epoch_start_w = pre.copy() if pre is not None \
+                else self.trainer.weights.map_read().copy()
 
     def finalize_class(self, cls: int) -> float:
         w = self.trainer.weights.map_read()
