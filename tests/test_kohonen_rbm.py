@@ -159,3 +159,32 @@ def test_kohonen_scan_min_delta_still_stops():
     hist = [h["metric_train"] for h in w.decision.metrics_history]
     assert hist[0] > 0.01, hist
     assert len(hist) < 50, len(hist)
+
+
+def test_kohonen_scan_midpass_falls_back_to_eager():
+    """A class pass entered mid-way (restored loader state after resume)
+    must still train: the scan guard only fires at offset 0, so the
+    remainder of the pass goes through the per-minibatch path."""
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.models.kohonen import build
+
+    prng.seed_all(21)
+    root.common.engine.scan_epoch = True
+    try:
+        w = build(max_epochs=3, shape=(4, 4), minibatch_size=25,
+                  n_train=100, sample_shape=(2,), min_delta=0.0)
+        w.initialize(device=TPUDevice())
+        assert w.trainer._scan_fn is not None
+        # simulate a resume that landed mid-pass: advance the loader two
+        # minibatches without letting the trainer see them
+        w.loader.run()
+        w.loader.run()
+        assert int(w.loader.minibatch_offset) > 0
+        w0 = np.asarray(w.trainer.weights.map_read()).copy()
+        w.trainer.run()          # mid-pass -> eager fallback, must train
+        w1 = np.asarray(w.trainer.weights.map_read())
+        assert np.abs(w1 - w0).max() > 0, "mid-pass minibatch not trained"
+        assert not w.trainer._scan_in_flight
+    finally:
+        root.common.engine.scan_epoch = False

@@ -1,0 +1,36 @@
+"""Fused AdamW weight update — TPU-native extension beyond the
+reference's SGD+momentum (gradient_descent.{cl,cu} has no adaptive
+optimizer; SURVEY.md §3.2).  Same fusion contract as ops/sgd.py: one
+function XLA collapses into a couple of elementwise HBM passes inside
+the fused train step.
+
+Update rule (decoupled weight decay, Loshchilov & Hutter):
+
+    g     = grad_sum / batch_size
+    m'    = b1*m + (1-b1)*g
+    v'    = b2*v + (1-b2)*g^2
+    mhat  = m' / (1 - b1^t);  vhat = v' / (1 - b2^t)
+    w'    = w - lr * (mhat / (sqrt(vhat) + eps) + weight_decay * w)
+
+``t`` is the POST-increment step count (the caller advances it once per
+step and passes the advanced value, so the first step uses t=1).
+"""
+
+from __future__ import annotations
+
+
+def update(xp, w, grad_sum, m, v, t, learning_rate, weight_decay,
+           beta1, beta2, eps, batch_size):
+    """One AdamW step -> ``(w_new, m_new, v_new)``.
+
+    All hyperparams may be traced scalars; ``t`` is a (traced) f32 step
+    count ALREADY advanced for this step.  ``batch_size`` may be traced
+    (masked tail minibatches divide by the real sample count).
+    """
+    g = grad_sum / batch_size
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * (g * g)
+    mhat = m_new / (1.0 - beta1 ** t)
+    vhat = v_new / (1.0 - beta2 ** t)
+    step = mhat / (xp.sqrt(vhat) + eps) + weight_decay * w
+    return w - learning_rate * step, m_new, v_new

@@ -69,11 +69,29 @@ from znicz_tpu.units.evaluator import EvaluatorMSE, EvaluatorSoftmax
 class FusedTrainStep(Unit):
     """One-unit replacement for the accelerated segment of the graph."""
 
+    #: optimizer registry: adamw state lives in extra leaf entries
+    #: (sw/sb second moments, t step count) snapshotted via
+    #: extra_state_arrays/load_extra_state
+    OPTIMIZERS = ("sgd", "adam")
+    ADAM_DEFAULTS = {"beta1": 0.9, "beta2": 0.999, "eps": 1e-8}
+
     def __init__(self, workflow=None, forwards=None, evaluator=None,
                  gds=None, loader=None, mesh: Optional[Mesh] = None,
                  donate: bool = True, defer_metrics: bool = True,
-                 scan_epoch: Optional[bool] = None, **kwargs) -> None:
+                 scan_epoch: Optional[bool] = None,
+                 optimizer: str = "sgd",
+                 optimizer_config: Optional[dict] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
+        if optimizer not in self.OPTIMIZERS:
+            raise ValueError(f"unknown optimizer {optimizer!r}; "
+                             f"registered: {self.OPTIMIZERS}")
+        #: "sgd" (reference semantics: momentum folded into the gd units'
+        #: gradient buffers) or "adam" (AdamW, beyond-reference; lr and
+        #: weight decay still come from the gd units' hyperparams, so LR
+        #: schedule units keep working)
+        self.optimizer = optimizer
+        self.optimizer_config = {**self.ADAM_DEFAULTS,
+                                 **(optimizer_config or {})}
         #: dispatch one compiled lax.scan per CLASS PASS instead of one
         #: program per minibatch (requires the pinned dataset; same
         #: "virtual minibatch" Decision accounting as defer_metrics).
@@ -140,6 +158,15 @@ class FusedTrainStep(Unit):
                 leaf["vb"] = put(np.zeros_like(fwd.bias.map_read())) \
                     if not gd.gradient_bias \
                     else put(gd.gradient_bias.map_read())
+            if self.optimizer == "adam":
+                # vw/vb double as first moments; second moments + step
+                # count are step-level state (restored from snapshots via
+                # load_extra_state AFTER this rebuild)
+                if "w" in leaf:
+                    leaf["sw"] = put(np.zeros_like(fwd.weights.map_read()))
+                if "b" in leaf:
+                    leaf["sb"] = put(np.zeros_like(fwd.bias.map_read()))
+                leaf["t"] = put(np.float32(0.0))
             params.append(leaf)
         return params
 
@@ -167,6 +194,28 @@ class FusedTrainStep(Unit):
                 jax.tree.map(np.float32, host), rep)
             self._hyper_cache = (sig, dev)
         return self._hyper_cache[1]
+
+    def extra_state_arrays(self) -> dict:
+        """Optimizer state that has no unit Array home (adam second
+        moments + step count) -> host arrays for the snapshotter."""
+        out = {}
+        if self.optimizer == "sgd" or self._params is None:
+            return out
+        for i, leaf in enumerate(self._params):
+            for k in ("sw", "sb", "t"):
+                if k in leaf:
+                    out[f"{i}.{k}"] = np.asarray(jax.device_get(leaf[k]))
+        return out
+
+    def load_extra_state(self, arrays: dict) -> None:
+        """Restore extra_state_arrays output into the (already rebuilt)
+        device params — call after gather_params on resume."""
+        from jax.sharding import NamedSharding
+        rep = NamedSharding(self.mesh, P())
+        for key, val in arrays.items():
+            i, k = key.split(".", 1)
+            self._params[int(i)][k] = jax.device_put(
+                np.asarray(val), rep)
 
     def sync_to_units(self) -> None:
         """Write the device params back into the unit Arrays (snapshot /
@@ -280,14 +329,30 @@ class FusedTrainStep(Unit):
         new_params = []
         for leaf, grad, h in zip(params, grads, hyper):
             new = dict(leaf)
-            if "w" in leaf:
-                new["w"], new["vw"] = upd(
-                    leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
-                    h["l1"], h["mom"], bs)
-            if "b" in leaf:
-                new["b"], new["vb"] = upd(
-                    leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
-                    h["wd_b"], h["l1"], h["mom_b"], bs)
+            if self.optimizer == "adam":
+                from znicz_tpu.ops import adam
+                cfg = self.optimizer_config
+                t_new = leaf["t"] + 1.0
+                if "w" in leaf:
+                    new["w"], new["vw"], new["sw"] = adam.update(
+                        jnp, leaf["w"], grad["w"], leaf["vw"], leaf["sw"],
+                        t_new, h["lr"], h["wd"], cfg["beta1"],
+                        cfg["beta2"], cfg["eps"], bs)
+                if "b" in leaf:
+                    new["b"], new["vb"], new["sb"] = adam.update(
+                        jnp, leaf["b"], grad["b"], leaf["vb"], leaf["sb"],
+                        t_new, h["lr_b"], h["wd_b"], cfg["beta1"],
+                        cfg["beta2"], cfg["eps"], bs)
+                new["t"] = t_new
+            else:
+                if "w" in leaf:
+                    new["w"], new["vw"] = upd(
+                        leaf["w"], grad["w"], leaf["vw"], h["lr"], h["wd"],
+                        h["l1"], h["mom"], bs)
+                if "b" in leaf:
+                    new["b"], new["vb"] = upd(
+                        leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
+                        h["wd_b"], h["l1"], h["mom_b"], bs)
             new_params.append(new)
         return new_params, key, metrics
 
@@ -317,6 +382,15 @@ class FusedTrainStep(Unit):
             if unit is not None and not unit.initialized:
                 unit.initialize(device=device, **kwargs)
                 unit.initialized = True
+        if self.optimizer == "adam":
+            # the adam branch reads lr/wd only; a configured L1 mix would
+            # be silently dropped — refuse like the fused=False guard
+            bad = [gd.name for gd in self.gds
+                   if float(getattr(gd, "l1_vs_l2", 0.0)) != 0.0]
+            if bad:
+                raise ValueError(
+                    f"l1_vs_l2 is SGD-only (adam applies decoupled L2 "
+                    f"weight decay); set it to 0 on: {bad}")
         if self.mesh is None:
             self.mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
         n_data = self.mesh.shape["data"]

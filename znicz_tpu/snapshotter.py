@@ -56,6 +56,10 @@ def collect_state(workflow) -> tuple[dict, dict]:
         # the device-resident PRNG key is training state: per-step keys are
         # split from it, so bit-exact resume must restore it
         arrays["step.key"] = np.asarray(jax.device_get(step._key))
+    if step is not None and hasattr(step, "extra_state_arrays"):
+        # optimizer state with no unit home (adam 2nd moments, step count)
+        for k, v in step.extra_state_arrays().items():
+            arrays[f"step.opt.{k}"] = v
     loader_state = workflow.loader.state_dict()
     for cls, order in loader_state.pop("shuffled").items():
         arrays[f"loader.shuffled.{cls}"] = np.asarray(order)
@@ -72,6 +76,8 @@ def collect_state(workflow) -> tuple[dict, dict]:
         "decision": workflow.decision.state_dict(),
         "prng": prng.state_dict(),
     }
+    if step is not None and hasattr(step, "optimizer"):
+        meta["optimizer"] = step.optimizer
     return arrays, meta
 
 
@@ -124,6 +130,16 @@ def restore_state(workflow, path: str) -> dict:
     prng.load_state_dict(meta["prng"])
     step = getattr(workflow, "step", None)
     if step is not None and getattr(step, "_params", None) is not None:
+        # optimizer identity is training state: resuming adam moments as
+        # sgd momentum (or adam from zeroed second moments) would change
+        # semantics silently — fail loudly like the architecture check.
+        # Snapshots predating the meta key were all sgd.
+        snap_opt = meta.get("optimizer", "sgd")
+        if getattr(step, "optimizer", "sgd") != snap_opt:
+            raise ValueError(
+                f"snapshot optimizer {snap_opt!r} != workflow optimizer "
+                f"{step.optimizer!r}; rebuild the workflow with "
+                f"optimizer={snap_opt!r}")
         step._params = step.gather_params()  # re-place restored weights
         # a restored normalizer may have re-normalized the loader's served
         # data: refresh the HBM-pinned dataset copy too
@@ -133,6 +149,10 @@ def restore_state(workflow, path: str) -> dict:
             step._key = jax.device_put(
                 arrays["step.key"],
                 NamedSharding(step.mesh, PartitionSpec()))
+        opt = {k[len("step.opt."):]: v for k, v in arrays.items()
+               if k.startswith("step.opt.")}
+        if opt:
+            step.load_extra_state(opt)
     return meta
 
 

@@ -123,7 +123,10 @@ class StandardWorkflow(StandardWorkflowBase):
                  decision_config: Optional[dict] = None,
                  snapshotter_config: Optional[dict] = None,
                  fused: bool = True, mesh=None,
-                 defer_metrics: bool = True, **kwargs) -> None:
+                 defer_metrics: bool = True,
+                 optimizer: str = "sgd",
+                 optimizer_config: Optional[dict] = None,
+                 **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
             raise ValueError(f"unknown loss_function {loss_function!r}")
@@ -133,6 +136,13 @@ class StandardWorkflow(StandardWorkflowBase):
         self.fused = fused
         self.mesh = mesh
         self.defer_metrics = defer_metrics
+        #: "sgd" (reference parity, eager + fused) or "adam" (AdamW,
+        #: fused-only extension — the eager gd units carry SGD semantics)
+        self.optimizer = optimizer
+        self.optimizer_config = optimizer_config
+        if optimizer != "sgd" and not fused:
+            raise ValueError(f"optimizer {optimizer!r} requires fused=True "
+                             f"(the eager gd units implement SGD only)")
         self.snapshotter = None
         self.create_workflow()
 
@@ -220,7 +230,8 @@ class StandardWorkflow(StandardWorkflowBase):
         step = self.step = FusedTrainStep(
             self, forwards=self.forwards, evaluator=self.evaluator,
             gds=self.gds, loader=self.loader, mesh=self.mesh,
-            defer_metrics=self.defer_metrics, name="FusedStep")
+            defer_metrics=self.defer_metrics, optimizer=self.optimizer,
+            optimizer_config=self.optimizer_config, name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control
