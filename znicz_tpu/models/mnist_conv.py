@@ -37,7 +37,9 @@ def build(max_epochs: int = 10, minibatch_size: int = 100,
           n_train: int = 2000, n_valid: int = 500, fused: bool = True,
           mesh=None, loader_name: str = "mnist",
           loader_config: dict | None = None,
-          snapshotter_config: dict | None = None) -> StandardWorkflow:
+          snapshotter_config: dict | None = None,
+          optimizer: str = "sgd",
+          optimizer_config: dict | None = None) -> StandardWorkflow:
     if loader_name == "mnist":
         cfg = {"n_train": n_train, "n_valid": n_valid,
                "minibatch_size": minibatch_size,
@@ -52,7 +54,8 @@ def build(max_epochs: int = 10, minibatch_size: int = 100,
         name="MnistConv", layers=LAYERS, loss_function="softmax",
         loader_name=loader_name, loader_config=cfg,
         decision_config={"max_epochs": max_epochs},
-        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh,
+        optimizer=optimizer, optimizer_config=optimizer_config)
 
 
 def run(load, main):
