@@ -20,3 +20,4 @@ from znicz_tpu.ops.pallas.conv import conv2d_im2col  # noqa: F401
 from znicz_tpu.ops.pallas.pooling import stochastic_pool  # noqa: F401
 from znicz_tpu.ops.pallas.kohonen import som_step  # noqa: F401
 from znicz_tpu.ops.pallas.attention import flash_attention  # noqa: F401
+from znicz_tpu.ops.pallas.adam import fused_adam_update  # noqa: F401

@@ -1,0 +1,69 @@
+"""Shared scaffolding for fused elementwise optimizer kernels (SGD,
+AdamW): 2-D view, row tiling under a VMEM budget, SMEM hyperparameter
+pack, vma-aware out specs, and in-place aliasing.
+
+Returns ``None`` when no tile fits VMEM (pathologically wide rows) — the
+caller falls back to its jnp implementation, which XLA fuses well enough
+that correctness never depends on the Pallas path."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: conservative VMEM working-set budget (bytes) for in+out tiles
+VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _pick_tile(rows: int, cols: int, n_buffers: int) -> int:
+    """Largest workable row tile: whole-array when it fits (one grid
+    step), else the biggest power-of-two divisor of ``rows`` that fits,
+    else 0 (= no tile fits; caller must fall back)."""
+    def fits(t: int) -> bool:
+        return t * cols * 4 * n_buffers <= VMEM_BUDGET
+
+    if fits(rows):
+        return rows
+    for t in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if rows % t == 0 and fits(t):
+            return t
+    return 0
+
+
+def tiled_update(kernel, hyper_scalars, arrays, aliases: dict,
+                 n_out: int, *, interpret: bool = False):
+    """Run ``kernel(h_ref, *in_refs, *out_refs)`` tiled over same-shaped
+    ``arrays`` (first array defines shape/dtype).  ``aliases`` maps
+    operand index (1-based: 0 is the SMEM hyper pack) -> output index for
+    in-place updates.  Returns a tuple of ``n_out`` arrays reshaped to
+    the input shape, or ``None`` if no tile fits VMEM."""
+    orig_shape = arrays[0].shape
+    a2 = [a.reshape(-1, orig_shape[-1]) if a.ndim != 2 else a
+          for a in arrays]
+    rows, cols = a2[0].shape
+    tile = _pick_tile(rows, cols, len(arrays) + n_out)
+    if tile == 0:
+        return None
+    hyper = jnp.stack([jnp.asarray(h, jnp.float32)
+                       for h in hyper_scalars])
+    spec = pl.BlockSpec((tile, cols), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    # under shard_map, outputs must declare their varying-axes type; the
+    # update preserves the weights' vma (replicated params stay replicated)
+    vma = getattr(jax.typeof(a2[0]), "vma", None)
+    out = jax.ShapeDtypeStruct(a2[0].shape, a2[0].dtype, vma=vma)
+    results = pl.pallas_call(
+        kernel,
+        grid=(rows // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] +
+                 [spec] * len(arrays),
+        out_specs=(spec,) * n_out,
+        out_shape=(out,) * n_out,
+        input_output_aliases=dict(aliases),
+        interpret=interpret,
+    )(hyper, *a2)
+    if n_out == 1:
+        results = (results,)
+    return tuple(r.reshape(orig_shape) for r in results)

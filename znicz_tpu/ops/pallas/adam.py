@@ -1,0 +1,48 @@
+"""Fused AdamW update as one Pallas kernel — the single-HBM-pass version
+of znicz_tpu.ops.adam.update, completing the optimizer kernel family next
+to the fused SGD kernel (ops/pallas/sgd.py; SURVEY.md §3.2 "fused
+SGD-update" parity deliverable, extended to the AdamW path).
+
+Weights/grad/moments stream HBM -> VMEM tile by tile; hyperparameters
+(including the post-increment step count ``t``) ride SMEM as scalars;
+outputs alias the weight/moment inputs (true in-place update).  Shapes
+whose rows cannot tile into VMEM fall back to the jnp implementation."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops import adam as adam_ops
+from znicz_tpu.ops.pallas._elementwise import tiled_update
+
+
+def _kernel(h_ref, w_ref, g_ref, m_ref, v_ref, w_out, m_out, v_out):
+    lr, wd, b1, b2, eps, t, bs = (h_ref[0], h_ref[1], h_ref[2], h_ref[3],
+                                  h_ref[4], h_ref[5], h_ref[6])
+    w = w_ref[:]
+    g = g_ref[:] / bs
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * (g * g)
+    mhat = m / (1.0 - b1 ** t)
+    vhat = v / (1.0 - b2 ** t)
+    w_out[:] = w - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * w)
+    m_out[:] = m
+    v_out[:] = v
+
+
+def fused_adam_update(w, grad, m, v, t, learning_rate, weight_decay,
+                     beta1, beta2, eps, batch_size, *,
+                     interpret: bool = False):
+    """(w, m, v) -> (w', m', v') with ops.adam.update semantics, one
+    pass.  ``t`` is the POST-increment step count (caller advances it).
+    Arrays of any rank; scalars may be traced."""
+    result = tiled_update(
+        _kernel,
+        [learning_rate, weight_decay, beta1, beta2, eps, t, batch_size],
+        (w, grad, m, v), aliases={1: 0, 3: 1, 4: 2}, n_out=3,
+        interpret=interpret)
+    if result is None:
+        return adam_ops.update(jnp, w, grad, m, v, t, learning_rate,
+                               weight_decay, beta1, beta2, eps,
+                               batch_size)
+    return result

@@ -5,17 +5,15 @@ launch, gradient_descent.{cl,cu} — SURVEY.md §3.2).
 
 Weights/grad/velocity stream HBM -> VMEM tile by tile; hyperparameters
 ride SMEM as scalars; outputs alias the weight/velocity inputs (true
-in-place update, no extra HBM traffic).
-"""
+in-place update, no extra HBM traffic).  Shapes whose rows cannot tile
+into VMEM fall back to the jnp implementation."""
 
 from __future__ import annotations
 
-import numpy as np
-
-import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from znicz_tpu.ops import sgd as sgd_ops
+from znicz_tpu.ops.pallas._elementwise import tiled_update
 
 
 def _kernel(h_ref, w_ref, g_ref, v_ref, w_out, v_out):
@@ -35,36 +33,14 @@ def fused_sgd_update(w, grad, vel, learning_rate, weights_decay, l1_vs_l2,
     Arrays of any rank (tiled over a 2-D view); hyperparams may be traced
     scalars.  ``interpret=True`` runs the Mosaic interpreter (CPU tests).
     """
-    orig_shape = w.shape
-    w2 = w.reshape(-1, orig_shape[-1]) if w.ndim != 2 else w
-    g2 = grad.reshape(w2.shape)
-    v2 = vel.reshape(w2.shape)
-    hyper = jnp.stack([
-        jnp.asarray(learning_rate, jnp.float32),
-        jnp.asarray(weights_decay, jnp.float32),
-        jnp.asarray(l1_vs_l2, jnp.float32),
-        jnp.asarray(gradient_moment, jnp.float32),
-        jnp.asarray(batch_size, jnp.float32)])
-    rows = w2.shape[0]
-    # row-tile so big embeddings stream through VMEM; lane dim stays whole
-    tile = rows if rows <= 512 else 256
-    grid = (pl.cdiv(rows, tile),) if rows % tile == 0 else None
-    if grid is None:      # ragged rows: single block (still one HBM pass)
-        tile, grid = rows, (1,)
-    spec = pl.BlockSpec((tile, w2.shape[1]), lambda i: (i, 0),
-                        memory_space=pltpu.VMEM)
-    # under shard_map, outputs must declare their varying-axes type; the
-    # update preserves the weights' vma (replicated params stay replicated)
-    vma = getattr(jax.typeof(w2), "vma", None)
-    out = jax.ShapeDtypeStruct(w2.shape, w2.dtype, vma=vma)
-    w_new, v_new = pl.pallas_call(
+    result = tiled_update(
         _kernel,
-        grid=grid,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  spec, spec, spec],
-        out_specs=(spec, spec),
-        out_shape=(out, out),
-        input_output_aliases={1: 0, 3: 1},
-        interpret=interpret,
-    )(hyper, w2, g2, v2)
-    return w_new.reshape(orig_shape), v_new.reshape(orig_shape)
+        [learning_rate, weights_decay, l1_vs_l2, gradient_moment,
+         batch_size],
+        (w, grad, vel), aliases={1: 0, 3: 1}, n_out=2,
+        interpret=interpret)
+    if result is None:
+        return sgd_ops.update(jnp, w, grad, vel, learning_rate,
+                              weights_decay, l1_vs_l2, gradient_moment,
+                              batch_size)
+    return result

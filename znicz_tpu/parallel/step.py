@@ -314,35 +314,47 @@ class FusedTrainStep(Unit):
         # SGD backend: XLA-fused by default; the Pallas single-HBM-pass
         # kernel when root.common.engine.pallas is set (SURVEY.md §3.2
         # "fused SGD-update" kernel parity deliverable)
-        if bool(root.common.engine.get("pallas", False)):
-            from znicz_tpu.ops.pallas import fused_sgd_update
-            interp = bool(root.common.engine.get("pallas_interpret", False))
+        use_pallas = bool(root.common.engine.get("pallas", False))
+        interp = bool(root.common.engine.get("pallas_interpret", False))
+        cfg = self.optimizer_config
+        if use_pallas:
+            from znicz_tpu.ops.pallas import (fused_adam_update,
+                                              fused_sgd_update)
 
             def upd(w, g, v, lr, wd, l1, mom, bsz):
                 return fused_sgd_update(w, g, v, lr, wd, l1, mom,
                                         bsz.astype(jnp.float32),
                                         interpret=interp)
+
+            def adam_upd(w, g, m, s, t_new, lr, wd, bsz):
+                return fused_adam_update(
+                    w, g, m, s, t_new, lr, wd, cfg["beta1"],
+                    cfg["beta2"], cfg["eps"], bsz.astype(jnp.float32),
+                    interpret=interp)
         else:
+            from znicz_tpu.ops import adam
+
             def upd(w, g, v, lr, wd, l1, mom, bsz):
                 return sgd.update(jnp, w, g, v, lr, wd, l1, mom, bsz)
+
+            def adam_upd(w, g, m, s, t_new, lr, wd, bsz):
+                return adam.update(jnp, w, g, m, s, t_new, lr, wd,
+                                   cfg["beta1"], cfg["beta2"],
+                                   cfg["eps"], bsz)
 
         new_params = []
         for leaf, grad, h in zip(params, grads, hyper):
             new = dict(leaf)
             if self.optimizer == "adam":
-                from znicz_tpu.ops import adam
-                cfg = self.optimizer_config
                 t_new = leaf["t"] + 1.0
                 if "w" in leaf:
-                    new["w"], new["vw"], new["sw"] = adam.update(
-                        jnp, leaf["w"], grad["w"], leaf["vw"], leaf["sw"],
-                        t_new, h["lr"], h["wd"], cfg["beta1"],
-                        cfg["beta2"], cfg["eps"], bs)
+                    new["w"], new["vw"], new["sw"] = adam_upd(
+                        leaf["w"], grad["w"], leaf["vw"], leaf["sw"],
+                        t_new, h["lr"], h["wd"], bs)
                 if "b" in leaf:
-                    new["b"], new["vb"], new["sb"] = adam.update(
-                        jnp, leaf["b"], grad["b"], leaf["vb"], leaf["sb"],
-                        t_new, h["lr_b"], h["wd_b"], cfg["beta1"],
-                        cfg["beta2"], cfg["eps"], bs)
+                    new["b"], new["vb"], new["sb"] = adam_upd(
+                        leaf["b"], grad["b"], leaf["vb"], leaf["sb"],
+                        t_new, h["lr_b"], h["wd_b"], bs)
                 new["t"] = t_new
             else:
                 if "w" in leaf:
