@@ -32,7 +32,6 @@ class InteractiveLoader(Loader):
         #: 0 -> regression targets of sample_shape; >0 -> int class labels
         self.n_classes = int(n_classes)
         self._fill = 0            # total samples ever fed (ring position)
-        self._fed_targets = False
         # ring buffers live from construction so the host may feed()
         # before the workflow initializes (capacity is static anyway)
         self._buffer = np.zeros((self.capacity,) + self.sample_shape,
@@ -53,16 +52,21 @@ class InteractiveLoader(Loader):
         if data.shape[1:] != self.sample_shape:
             raise ValueError(f"fed samples {data.shape[1:]} != declared "
                              f"sample_shape {self.sample_shape}")
+        if self.n_classes > 0 and labels is None:
+            raise ValueError("classification loader (n_classes > 0) needs "
+                             "labels with every feed()")
         if labels is not None:
             labels = np.asarray(labels)
             if len(labels) != len(data):
                 raise ValueError("labels/data length mismatch")
-            self._fed_targets = True
         for i in range(len(data)):
             slot = self._fill % self.capacity
             self._buffer[slot] = data[i]
-            if labels is not None:
-                self._label_buffer[slot] = labels[i]
+            # regression batches fed without targets train
+            # autoencoder-style against their own inputs — written into
+            # the target buffer PER SLOT, so mixed labeled/unlabeled
+            # feeds never pair rows with stale targets
+            self._label_buffer[slot] = labels[i] if labels is not None                 else data[i]
             self._fill += 1
         return self.available
 
@@ -100,8 +104,5 @@ class InteractiveLoader(Loader):
         if self.n_classes > 0:
             self.minibatch_labels.map_write()[...] = self._label_buffer[rows]
         else:
-            # regression targets default to the inputs themselves
-            # (autoencoder style) until feed() supplies explicit ones
             self.minibatch_targets.map_write()[...] = \
-                self._label_buffer[rows] if self._fed_targets \
-                else self._buffer[rows]
+                self._label_buffer[rows]

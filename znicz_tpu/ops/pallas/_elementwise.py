@@ -17,6 +17,21 @@ from jax.experimental.pallas import tpu as pltpu
 VMEM_BUDGET = 12 * 1024 * 1024
 
 
+def out_struct(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes: under
+    shard_map with vma checking, pallas_call outputs must declare which
+    mesh axes they vary over — same set as the operands.  Degrades to a
+    plain struct on pre-vma jax.  THE one copy of this policy (used by
+    the optimizer kernels here and the flash-attention kernel)."""
+    typeof = getattr(jax, "typeof", None)    # vma-era jax only
+    vma = getattr(typeof(like), "vma", None) if typeof else None
+    if vma is not None:
+        # an EMPTY frozenset means replicated — still required under
+        # check_vma; only a missing attribute (pre-vma jax) may omit it
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pick_tile(rows: int, cols: int, n_buffers: int) -> int:
     """Largest workable row tile: whole-array when it fits (one grid
     step), else the biggest power-of-two divisor of ``rows`` that fits,
@@ -50,10 +65,7 @@ def tiled_update(kernel, hyper_scalars, arrays, aliases: dict,
                        for h in hyper_scalars])
     spec = pl.BlockSpec((tile, cols), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
-    # under shard_map, outputs must declare their varying-axes type; the
-    # update preserves the weights' vma (replicated params stay replicated)
-    vma = getattr(jax.typeof(a2[0]), "vma", None)
-    out = jax.ShapeDtypeStruct(a2[0].shape, a2[0].dtype, vma=vma)
+    out = out_struct(a2[0].shape, a2[0].dtype, a2[0])
     results = pl.pallas_call(
         kernel,
         grid=(rows // tile,),

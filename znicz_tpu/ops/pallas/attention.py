@@ -116,15 +116,7 @@ def _flash(q, k, v, causal: bool, interpret: bool):
     return o
 
 
-def _out_struct(shape, dtype, like):
-    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes: under
-    shard_map with vma checking, pallas_call outputs must declare which
-    mesh axes they vary over — same set as the operands."""
-    typeof = getattr(jax, "typeof", None)    # vma-era jax only
-    vma = getattr(typeof(like), "vma", None) if typeof else None
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+from znicz_tpu.ops.pallas._elementwise import out_struct as _out_struct
 
 
 def _call_fwd(q, k, v, causal, interpret):

@@ -80,11 +80,19 @@ class FusedTrainStep(Unit):
                  donate: bool = True, defer_metrics: bool = True,
                  scan_epoch: Optional[bool] = None,
                  optimizer: str = "sgd",
-                 optimizer_config: Optional[dict] = None, **kwargs) -> None:
+                 optimizer_config: Optional[dict] = None,
+                 shard_update: bool = False, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         if optimizer not in self.OPTIMIZERS:
             raise ValueError(f"unknown optimizer {optimizer!r}; "
                              f"registered: {self.OPTIMIZERS}")
+        #: ZeRO-style cross-replica sharding of the weight update (Xu et
+        #: al. 2020, arXiv:2004.13336): gradients reduce-scatter over the
+        #: ``data`` axis, each replica updates only its 1/n shard of the
+        #: params with its 1/n shard of the OPTIMIZER STATE (momenta live
+        #: sharded — the memory win), and updated params all-gather back.
+        #: Numerically equivalent to the replicated update.
+        self.shard_update = bool(shard_update)
         #: "sgd" (reference semantics: momentum folded into the gd units'
         #: gradient buffers) or "adam" (AdamW, beyond-reference; lr and
         #: weight decay still come from the gd units' hyperparams, so LR
@@ -139,36 +147,64 @@ class FusedTrainStep(Unit):
         self.minibatch_size = 0
 
     # -- parameter pytree ---------------------------------------------------
+    #: leaf keys holding optimizer state (sharded under shard_update)
+    OPT_STATE_KEYS = ("vw", "vb", "sw", "sb")
+
+    def _flat_shard_put(self, host_arr):
+        """Flatten + pad an optimizer-state array and place it sharded
+        over the ``data`` axis (ZeRO layout)."""
+        from jax.sharding import NamedSharding
+        n = self.mesh.shape["data"]
+        flat = np.asarray(host_arr, np.float32).reshape(-1)
+        flat = np.pad(flat, (0, (-len(flat)) % n))
+        return jax.device_put(flat, NamedSharding(self.mesh, P("data")))
+
     def gather_params(self):
-        """Build the params pytree from the unit Arrays, placed replicated
-        over the mesh — the same sharding the step outputs, so the jit
-        signature is stable from the first call."""
+        """Build the params pytree from the unit Arrays: w/b replicated
+        over the mesh (the sharding the step outputs, so the jit
+        signature is stable from the first call); optimizer-state leaves
+        flat-sharded over ``data`` when ``shard_update``."""
         from jax.sharding import NamedSharding
         rep = NamedSharding(self.mesh, P())
         put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
+        put_v = self._flat_shard_put if self.shard_update else put
         params = []
         for fwd, gd in zip(self.forwards, self.gds):
             leaf = {k: put(arr.map_read())
                     for k, arr in fwd.param_arrays().items()}
             if "w" in leaf:
-                leaf["vw"] = put(np.zeros_like(fwd.weights.map_read())) \
+                leaf["vw"] = put_v(np.zeros_like(fwd.weights.map_read())) \
                     if not gd.gradient_weights \
-                    else put(gd.gradient_weights.map_read())
+                    else put_v(gd.gradient_weights.map_read())
             if "b" in leaf:
-                leaf["vb"] = put(np.zeros_like(fwd.bias.map_read())) \
+                leaf["vb"] = put_v(np.zeros_like(fwd.bias.map_read())) \
                     if not gd.gradient_bias \
-                    else put(gd.gradient_bias.map_read())
+                    else put_v(gd.gradient_bias.map_read())
             if self.optimizer == "adam":
                 # vw/vb double as first moments; second moments + step
                 # count are step-level state (restored from snapshots via
                 # load_extra_state AFTER this rebuild)
                 if "w" in leaf:
-                    leaf["sw"] = put(np.zeros_like(fwd.weights.map_read()))
+                    leaf["sw"] = put_v(
+                        np.zeros_like(fwd.weights.map_read()))
                 if "b" in leaf:
-                    leaf["sb"] = put(np.zeros_like(fwd.bias.map_read()))
+                    leaf["sb"] = put_v(np.zeros_like(fwd.bias.map_read()))
                 leaf["t"] = put(np.float32(0.0))
             params.append(leaf)
         return params
+
+    def param_specs(self):
+        """Per-leaf PartitionSpecs matching gather_params' placement."""
+        vspec = P("data") if self.shard_update else P()
+        return [{k: (vspec if k in self.OPT_STATE_KEYS else P())
+                 for k in leaf} for leaf in self._params]
+
+    def _unshard_state(self, leaf_val, like_shape):
+        """Sharded flat optimizer-state array -> host array of the
+        original parameter shape."""
+        flat = np.asarray(jax.device_get(leaf_val))
+        size = int(np.prod(like_shape))
+        return flat[:size].reshape(like_shape)
 
     def hyper_params(self):
         """Per-layer hyperparams as host floats (traced scalars)."""
@@ -195,16 +231,27 @@ class FusedTrainStep(Unit):
             self._hyper_cache = (sig, dev)
         return self._hyper_cache[1]
 
+    def _param_shape(self, i: int, key: str):
+        fwd = self.forwards[i]
+        return (fwd.weights if key.endswith("w") else fwd.bias).shape
+
     def extra_state_arrays(self) -> dict:
         """Optimizer state that has no unit Array home (adam second
-        moments + step count) -> host arrays for the snapshotter."""
+        moments + step count) -> host arrays for the snapshotter, always
+        in the PARAM shape (snapshots stay layout-independent: a sharded
+        run restores into a replicated one and vice versa)."""
         out = {}
         if self.optimizer == "sgd" or self._params is None:
             return out
         for i, leaf in enumerate(self._params):
             for k in ("sw", "sb", "t"):
-                if k in leaf:
+                if k not in leaf:
+                    continue
+                if k == "t" or not self.shard_update:
                     out[f"{i}.{k}"] = np.asarray(jax.device_get(leaf[k]))
+                else:
+                    out[f"{i}.{k}"] = self._unshard_state(
+                        leaf[k], self._param_shape(i, k))
         return out
 
     def load_extra_state(self, arrays: dict) -> None:
@@ -214,19 +261,36 @@ class FusedTrainStep(Unit):
         rep = NamedSharding(self.mesh, P())
         for key, val in arrays.items():
             i, k = key.split(".", 1)
-            self._params[int(i)][k] = jax.device_put(
-                np.asarray(val), rep)
+            if k != "t" and self.shard_update:
+                self._params[int(i)][k] = self._flat_shard_put(val)
+            else:
+                self._params[int(i)][k] = jax.device_put(
+                    np.asarray(val), rep)
 
     def sync_to_units(self) -> None:
         """Write the device params back into the unit Arrays (snapshot /
         inspection path; the hot loop never does this)."""
-        for fwd, gd, leaf in zip(self.forwards, self.gds, self._params):
+        for i, (fwd, gd, leaf) in enumerate(
+                zip(self.forwards, self.gds, self._params)):
             if "w" in leaf:
                 fwd.weights.set_devmem(leaf["w"])
-                gd.gradient_weights.set_devmem(leaf["vw"])
             if "b" in leaf:
                 fwd.bias.set_devmem(leaf["b"])
-                gd.gradient_bias.set_devmem(leaf["vb"])
+            if not self.shard_update:
+                if "w" in leaf:
+                    gd.gradient_weights.set_devmem(leaf["vw"])
+                if "b" in leaf:
+                    gd.gradient_bias.set_devmem(leaf["vb"])
+                continue
+            # sharded momenta: reassemble to the param shape host-side
+            if "w" in leaf:
+                gd.gradient_weights.map_invalidate()
+                gd.gradient_weights.mem = self._unshard_state(
+                    leaf["vw"], fwd.weights.shape)
+            if "b" in leaf:
+                gd.gradient_bias.map_invalidate()
+                gd.gradient_bias.mem = self._unshard_state(
+                    leaf["vb"], fwd.bias.shape)
 
     # -- forward / loss composition -----------------------------------------
     def _forward_chain(self, params, x, train: bool, rng=None):
@@ -300,12 +364,13 @@ class FusedTrainStep(Unit):
                                                    rng=rng)
             loss, metrics = self._loss_and_metrics(
                 out, logits_tail, labels, mask)
+            metrics = jax.lax.psum(metrics, "data")
             # the gradient plane: differentiating through this psum makes AD
             # itself produce the globally-summed gradient of the replicated
             # params — one ICI collective replacing the reference's whole
             # ZeroMQ weight-shipping protocol.  (Do NOT psum the grads again
             # outside: replicated-input cotangents are already reduced.)
-            return jax.lax.psum(loss, "data"), jax.lax.psum(metrics, "data")
+            return jax.lax.psum(loss, "data"), metrics
 
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(trainable)
@@ -342,11 +407,65 @@ class FusedTrainStep(Unit):
                                    cfg["beta1"], cfg["beta2"],
                                    cfg["eps"], bsz)
 
+        if self.shard_update:
+            n_data = self.mesh.shape["data"]   # static: pad math below
+            rank = jax.lax.axis_index("data")
+
+            def my_slice(w):
+                flat = w.reshape(-1)
+                pad = (-flat.shape[0]) % n_data
+                flat = jnp.pad(flat, (0, pad))
+                shard = flat.shape[0] // n_data
+                return jax.lax.dynamic_slice(flat, (rank * shard,),
+                                             (shard,))
+
+            def regather(w_shard, like):
+                # place the shard at this replica's offset and psum: the
+                # same reassembly as all_gather, but psum PROVABLY yields
+                # a replicated value, so the params' P() out_spec
+                # type-checks under the vma system
+                shard = w_shard.shape[0]
+                buf = jnp.zeros((shard * n_data,), w_shard.dtype)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, w_shard, (rank * shard,))
+                full = jax.lax.psum(buf, "data")
+                return full[:int(np.prod(like.shape))].reshape(like.shape)
+
+            def apply(leaf, grad, h, wk, vk, sk, lr_k, wd_k, new, t_new):
+                # the grads arrive ALREADY globally summed: the vma
+                # system requires cotangents of unvaried (replicated)
+                # primals to be unvaried, so AD inserts the cross-replica
+                # psum itself.  Each replica therefore just slices its
+                # shard — the sharding win is the ZeRO-1 one (optimizer
+                # state + update compute at 1/n), not grad bandwidth
+                g = my_slice(grad[wk])
+                w_sh = my_slice(leaf[wk])
+                if self.optimizer == "adam":
+                    w_sh, new[vk], new[sk] = adam_upd(
+                        w_sh, g, leaf[vk], leaf[sk], t_new, h[lr_k],
+                        h[wd_k], bs)
+                else:
+                    mom_k = "mom" if wk == "w" else "mom_b"
+                    w_sh, new[vk] = upd(w_sh, g, leaf[vk], h[lr_k],
+                                        h[wd_k], h["l1"], h[mom_k], bs)
+                new[wk] = regather(w_sh, leaf[wk])
+        else:
+            apply = None
+
         new_params = []
         for leaf, grad, h in zip(params, grads, hyper):
             new = dict(leaf)
-            if self.optimizer == "adam":
-                t_new = leaf["t"] + 1.0
+            t_new = leaf["t"] + 1.0 if self.optimizer == "adam" else None
+            if apply is not None:
+                if "w" in leaf:
+                    apply(leaf, grad, h, "w", "vw", "sw", "lr", "wd",
+                          new, t_new)
+                if "b" in leaf:
+                    apply(leaf, grad, h, "b", "vb", "sb", "lr_b", "wd_b",
+                          new, t_new)
+                if t_new is not None:
+                    new["t"] = t_new
+            elif self.optimizer == "adam":
                 if "w" in leaf:
                     new["w"], new["vw"], new["sw"] = adam_upd(
                         leaf["w"], grad["w"], leaf["vw"], leaf["sw"],
@@ -419,11 +538,12 @@ class FusedTrainStep(Unit):
         self._key = jax.device_put(prng.get().key(),
                                    NamedSharding(self.mesh, P()))
         rep, sh = P(), P("data")
+        pspecs = self.param_specs()
         train = shard_map(self._local_train, mesh=self.mesh,
-                          in_specs=(rep, rep, rep, sh, sh, sh),
-                          out_specs=(rep, rep, rep))
+                          in_specs=(pspecs, rep, rep, sh, sh, sh),
+                          out_specs=(pspecs, rep, rep))
         evalf = shard_map(self._local_eval, mesh=self.mesh,
-                          in_specs=(rep, sh, sh, sh),
+                          in_specs=(pspecs, sh, sh, sh),
                           out_specs=rep)
         donate = (0, 1) if self.donate else ()
         self._train_fn = jax.jit(train, donate_argnums=donate)
@@ -460,11 +580,12 @@ class FusedTrainStep(Unit):
             jax.device_put(data, rep_sh),
             jax.device_put(np.asarray(labels_arr.mem), rep_sh))
         rep, sh = P(), P("data")
+        pspecs = self.param_specs()
         train = shard_map(self._local_train_idx, mesh=self.mesh,
-                          in_specs=(rep, rep, rep, rep, rep, sh, sh),
-                          out_specs=(rep, rep, rep))
+                          in_specs=(pspecs, rep, rep, rep, rep, sh, sh),
+                          out_specs=(pspecs, rep, rep))
         evalf = shard_map(self._local_eval_idx, mesh=self.mesh,
-                          in_specs=(rep, rep, rep, sh, sh),
+                          in_specs=(pspecs, rep, rep, sh, sh),
                           out_specs=rep)
         donate = (0, 1) if self.donate else ()
         self._train_fn_idx = jax.jit(train, donate_argnums=donate)
@@ -503,14 +624,15 @@ class FusedTrainStep(Unit):
 
         rep = P()
         shs = P(None, "data")
+        pspecs = self.param_specs()
         donate = (0, 1) if self.donate else ()
         self._scan_idx_fns["train"] = jax.jit(shard_map(
             local_train_many, mesh=self.mesh,
-            in_specs=(rep, rep, rep, rep, rep, shs, shs),
-            out_specs=(rep, rep, rep)), donate_argnums=donate)
+            in_specs=(pspecs, rep, rep, rep, rep, shs, shs),
+            out_specs=(pspecs, rep, rep)), donate_argnums=donate)
         self._scan_idx_fns["eval"] = jax.jit(shard_map(
             local_eval_many, mesh=self.mesh,
-            in_specs=(rep, rep, rep, shs, shs),
+            in_specs=(pspecs, rep, rep, shs, shs),
             out_specs=rep))
         # plan capture costs an int64 matrix per class pass — only pay it
         # when this mode actually consumes it
@@ -530,9 +652,10 @@ class FusedTrainStep(Unit):
 
         rep = P()
         sh = P(None, "data")  # (step, batch, ...): batch axis sharded
+        pspecs = self.param_specs()
         fn = shard_map(local_many, mesh=self.mesh,
-                       in_specs=(rep, rep, rep, sh, sh, sh),
-                       out_specs=(rep, rep, rep))
+                       in_specs=(pspecs, rep, rep, sh, sh, sh),
+                       out_specs=(pspecs, rep, rep))
         donate = (0, 1) if self.donate else ()
         self._scan_fn = jax.jit(fn, donate_argnums=donate)
 

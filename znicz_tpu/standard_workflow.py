@@ -126,6 +126,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  defer_metrics: bool = True,
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
+                 shard_update: bool = False,
                  **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
@@ -140,9 +141,14 @@ class StandardWorkflow(StandardWorkflowBase):
         #: fused-only extension — the eager gd units carry SGD semantics)
         self.optimizer = optimizer
         self.optimizer_config = optimizer_config
+        #: ZeRO-style sharded weight update over the data axis
+        self.shard_update = shard_update
         if optimizer != "sgd" and not fused:
             raise ValueError(f"optimizer {optimizer!r} requires fused=True "
                              f"(the eager gd units implement SGD only)")
+        if shard_update and not fused:
+            raise ValueError("shard_update requires fused=True (the eager "
+                             "gd units keep fully replicated state)")
         self.snapshotter = None
         self.create_workflow()
 
@@ -231,7 +237,8 @@ class StandardWorkflow(StandardWorkflowBase):
             self, forwards=self.forwards, evaluator=self.evaluator,
             gds=self.gds, loader=self.loader, mesh=self.mesh,
             defer_metrics=self.defer_metrics, optimizer=self.optimizer,
-            optimizer_config=self.optimizer_config, name="FusedStep")
+            optimizer_config=self.optimizer_config,
+            shard_update=self.shard_update, name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control
