@@ -276,3 +276,49 @@ def test_shard_update_adam_snapshot_roundtrip(tmp_path, cpu_devices):
     got = [np.asarray(f.weights.map_read()).copy() for f in w_b.forwards]
     for a, b in zip(got, want):
         np.testing.assert_array_equal(a, b)
+
+
+def test_shard_update_snapshot_restores_across_layouts(tmp_path,
+                                                       cpu_devices):
+    """State is stored in param shape, so a sharded-update run restores
+    into a replicated one on a different mesh size (the elastic-resume
+    story) and continues identically."""
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+    from znicz_tpu.snapshotter import collect_state, restore_state, \
+        write_snapshot
+
+    def build(n_epochs, n_dev, shard):
+        prng.seed_all(7)
+        return build_fused(max_epochs=n_epochs, layers=(16,),
+                           minibatch_size=16, n_train=64, n_valid=0,
+                           mesh=data_parallel_mesh(n_dev),
+                           optimizer="adam", shard_update=shard)
+
+    # sharded over 8 devices, interrupted at 2 epochs
+    w_a = build(2, 8, True)
+    w_a.initialize(device=TPUDevice())
+    w_a.run()
+    arrays, meta = collect_state(w_a)
+    snap = str(tmp_path / "x.npz")
+    write_snapshot(snap, arrays, meta)
+
+    # oracle: continue the SAME layout to 4 epochs
+    w_o = build(4, 8, True)
+    w_o.initialize(device=TPUDevice())
+    w_o.run()
+    w_o.step.sync_to_units()
+    want = [np.asarray(f.weights.map_read()).copy()
+            for f in w_o.forwards]
+
+    # resume REPLICATED on a 2-device mesh from the sharded snapshot
+    w_b = build(4, 2, False)
+    w_b.initialize(device=TPUDevice())
+    restore_state(w_b, snap)
+    w_b.decision.max_epochs = 4
+    w_b.decision.complete.set(False)
+    w_b.run()
+    w_b.step.sync_to_units()
+    got = [np.asarray(f.weights.map_read()).copy() for f in w_b.forwards]
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
