@@ -408,28 +408,17 @@ class FusedTrainStep(Unit):
                                    cfg["eps"], bsz)
 
         if self.shard_update:
+            from znicz_tpu.parallel import zero
+
             n_data = self.mesh.shape["data"]   # static: pad math below
             rank = jax.lax.axis_index("data")
 
             def my_slice(w):
-                flat = w.reshape(-1)
-                pad = (-flat.shape[0]) % n_data
-                flat = jnp.pad(flat, (0, pad))
-                shard = flat.shape[0] // n_data
-                return jax.lax.dynamic_slice(flat, (rank * shard,),
-                                             (shard,))
+                return zero.pad_slice(w, rank, n_data)
 
             def regather(w_shard, like):
-                # place the shard at this replica's offset and psum: the
-                # same reassembly as all_gather, but psum PROVABLY yields
-                # a replicated value, so the params' P() out_spec
-                # type-checks under the vma system
-                shard = w_shard.shape[0]
-                buf = jnp.zeros((shard * n_data,), w_shard.dtype)
-                buf = jax.lax.dynamic_update_slice(
-                    buf, w_shard, (rank * shard,))
-                full = jax.lax.psum(buf, "data")
-                return full[:int(np.prod(like.shape))].reshape(like.shape)
+                return zero.psum_regather(w_shard, rank, n_data, "data",
+                                          like)
 
             def apply(leaf, grad, h, wk, vk, sk, lr_k, wd_k, new, t_new):
                 # the grads arrive ALREADY globally summed: the vma

@@ -147,7 +147,7 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
 
 def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
-                    compute_dtype=None):
+                    compute_dtype=None, shard_update: bool = False):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
@@ -158,6 +158,16 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     ``compute_dtype`` (bf16 on accelerators, see
     :func:`_default_compute_dtype`), and the loss/log-softmax runs f32.
     AD transposes the casts, so gradients land f32 on the masters.
+
+    ``shard_update`` applies the ZeRO-style cross-replica update split
+    (arXiv:2004.13336) to the REPLICATED leaves (embeddings, head,
+    layernorms): each data-axis replica updates a 1/n slice and the
+    slices reassemble through a psum.  NOTE the honest scope: this step
+    is stateless SGD, so there is no optimizer-state memory to shard —
+    the split divides the update COMPUTE and pins the numerics the
+    fused step's stateful shard_update (parallel/step.py, where the
+    ZeRO-1 memory win is real) must match.  Tensor-sharded leaves
+    already live partitioned and update locally.
     """
     tp_size = mesh.shape["model"]
     if heads % tp_size or d % tp_size or ff % tp_size:
@@ -169,6 +179,17 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
     use_flash = _flash_eligible(mesh, interp)
+    n_data = mesh.shape["data"]
+
+    def _sharded_sgd(w, g, scale):
+        """w - lr*g/scale computed on this replica's 1/n slice only,
+        reassembled via a (provably replicating) psum."""
+        from znicz_tpu.parallel import zero
+
+        rank = lax.axis_index("data")
+        new_sh = zero.pad_slice(w, rank, n_data) - \
+            lr * zero.pad_slice(g, rank, n_data) / scale
+        return zero.psum_regather(new_sh, rank, n_data, "data", w)
 
     def local_step(params, tokens, labels):
         def loss_fn(ps):
@@ -186,8 +207,21 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
-        new_params = jax.tree.map(
-            lambda w, g: w - lr * g / n_shards, params, grads)
+        if shard_update:
+            # PartitionSpec is a tuple subclass (a pytree container), so
+            # align specs to params by flattening with an is_leaf guard
+            flat_w, treedef = jax.tree.flatten(params)
+            flat_g = jax.tree.leaves(grads)
+            flat_s = jax.tree.leaves(
+                specs, is_leaf=lambda x: isinstance(x, P))
+            new_leaves = [
+                _sharded_sgd(w, g, n_shards) if s == P()
+                else w - lr * g / n_shards
+                for w, g, s in zip(flat_w, flat_g, flat_s)]
+            new_params = jax.tree.unflatten(treedef, new_leaves)
+        else:
+            new_params = jax.tree.map(
+                lambda w, g: w - lr * g / n_shards, params, grads)
         return new_params, loss / n_shards
 
     kwargs = {}

@@ -1,0 +1,34 @@
+"""ZeRO-style update-sharding primitives (Xu et al. 2020,
+arXiv:2004.13336) — THE one copy of the pad/slice/psum-reassembly logic
+shared by the fused workflow step (parallel/step.py) and the sharded
+transformer step (parallel/transformer.py).
+
+``psum_regather`` reassembles disjoint per-replica slices through a psum
+rather than an all_gather because psum PROVABLY yields a replicated
+value under shard_map's vma type system, so P() out_specs type-check.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_slice(x, rank, n: int):
+    """This replica's 1/n slice of ``x`` flattened and zero-padded to a
+    multiple of ``n``.  ``rank`` may be traced (lax.axis_index)."""
+    flat = x.reshape(-1)
+    flat = jnp.pad(flat, (0, (-flat.shape[0]) % n))
+    shard = flat.shape[0] // n
+    return jax.lax.dynamic_slice(flat, (rank * shard,), (shard,))
+
+
+def psum_regather(shard, rank, n: int, axis_name: str, like):
+    """Disjoint per-replica slices -> the full array of ``like``'s shape,
+    replicated (each replica writes its slice into a zero buffer at its
+    offset; the psum sums the disjoint contributions)."""
+    size = shard.shape[0]
+    buf = jnp.zeros((size * n,), shard.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, shard, (rank * size,))
+    full = jax.lax.psum(buf, axis_name)
+    return full[:like.size].reshape(like.shape)
