@@ -179,3 +179,34 @@ def test_shard_update_transformer_matches_replicated(cpu_devices):
         losses[mode] = run
     np.testing.assert_allclose(losses[True], losses[False],
                                rtol=1e-5, atol=1e-7)
+
+
+def test_bf16_pipeline_step_tracks_f32(cpu_devices):
+    """Mixed precision on the MoE pipeline step: bf16 losses track the
+    f32 oracle, params stay f32."""
+    import jax
+    import jax.numpy as jnp
+
+    prng.seed_all(25)
+    gen = prng.get()
+    d, ff, n_experts = 16, 32, 4
+    params = tfm.init_moe_pipeline_params(gen, n_stages=2, d=d, ff=ff,
+                                          n_experts=n_experts)
+    mesh = make_mesh({"data": 2, "pipe": 2, "expert": 2})
+    rng = np.random.default_rng(6)
+    xs = rng.normal(size=(4, 8, d)).astype(np.float32)
+    ys = xs * 0.5
+
+    losses = {}
+    for name, cdt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        step, _ = tfm.make_pipeline_step(mesh, n_experts, lr=0.05,
+                                         compute_dtype=cdt)
+        p = dict(params)
+        run = []
+        for _ in range(5):
+            p, loss = step(p, xs, ys)
+            run.append(float(loss))
+        losses[name] = run
+        assert all(leaf.dtype == jnp.float32
+                   for leaf in jax.tree.leaves(p)), name
+    np.testing.assert_allclose(losses["bf16"], losses["f32"], rtol=5e-2)

@@ -114,12 +114,9 @@ def main(argv=None) -> int:
             module, args.ensemble_train, args.random_seed,
             lambda: Launcher(device=make_device(args.device),
                              stealth=args.stealth))
-        # workflow display names are free text — keep the path safe
-        import re
+        from znicz_tpu.utils.naming import slugify
 
-        slug = re.sub(r"[^a-z0-9_.-]+", "_",
-                      str(summary["workflow"]).lower()) or "workflow"
-        out = f"ensemble_{slug}.json"
+        out = f"ensemble_{slugify(summary['workflow'])}.json"
         with open(out, "w") as f:
             json.dump(summary, f, indent=1)
         print(f"ensemble summary -> {out}")

@@ -266,18 +266,22 @@ def moe_pipeline_specs():
             for k in ("gate", "w1", "b1", "w2", "b2")}
 
 
-def make_pipeline_step(mesh: Mesh, n_experts: int, lr: float = 0.05):
+def make_pipeline_step(mesh: Mesh, n_experts: int, lr: float = 0.05,
+                       compute_dtype=None):
     """-> jitted ``step(params, xs, ys) -> (params, loss)`` on a
     ``(data, pipe, expert)`` mesh: each pipe stage is an expert-parallel
     MoE residual block; xs ``(n_micro, mb, d)`` microbatches (data-sharded
     on mb), ys same shape (regression targets — keeps the demo loss
-    self-contained).  Feature/ff sizes flow from the params pytree."""
+    self-contained).  Feature/ff sizes flow from the params pytree.
+    Mixed precision follows the same recipe as make_train_step: bf16
+    compute on accelerators, f32 masters/updates, f32 loss."""
     n_stages = mesh.shape["pipe"]
     ep = mesh.shape["expert"]
     if n_experts % ep:
         raise ValueError(f"expert-axis size {ep} must divide "
                          f"n_experts={n_experts}")
     specs = moe_pipeline_specs()
+    cdt = _default_compute_dtype(compute_dtype)
 
     def stage_fn(p, x):
         y, _ = moe_ffn(x, p["gate"][0], p["w1"][0], p["b1"][0],
@@ -286,9 +290,11 @@ def make_pipeline_step(mesh: Mesh, n_experts: int, lr: float = 0.05):
 
     def local_step(params, xs, ys):
         def loss_fn(ps):
-            out = pipeline_apply(lambda _unused, x: stage_fn(ps, x), None,
-                                 xs, n_stages, "pipe")
-            diff = out - ys
+            ps = jax.tree.map(lambda w: w.astype(cdt), ps)
+            out = pipeline_apply(
+                lambda _unused, x: stage_fn(ps, x), None,
+                xs.astype(cdt), n_stages, "pipe")
+            diff = out.astype(jnp.float32) - ys
             return lax.psum((diff * diff).mean(), "data")
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

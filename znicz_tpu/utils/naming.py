@@ -1,0 +1,11 @@
+"""Small shared utilities for the service layer."""
+
+from __future__ import annotations
+
+import re
+
+
+def slugify(name) -> str:
+    """Free-text display name -> filesystem-safe slug (workflow names
+    flow into report/summary paths)."""
+    return re.sub(r"[^a-z0-9_.-]+", "_", str(name).lower()) or "workflow"

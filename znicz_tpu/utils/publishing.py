@@ -158,11 +158,13 @@ class Publisher(Logger):
 
     def publish(self, workflow) -> str:
         """Write the report; returns the output path."""
+        from znicz_tpu.utils.naming import slugify
+
         report = collect_report(workflow)
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(
             self.directory,
-            f"{report['name'].lower()}_report{self.backend.EXT}")
+            f"{slugify(report['name'])}_report{self.backend.EXT}")
         with open(path, "w") as f:
             f.write(self.backend.render(report))
         self.info(f"report -> {path}")
