@@ -229,3 +229,24 @@ def test_launcher_profile_trace(tmp_path):
     for base, _dirs, files in os.walk(tmp_path / "trace"):
         found += files
     assert found, "no profiler trace files written"
+
+
+def test_trace_summary_reports_top_ops(tmp_path):
+    """summarize_trace turns a jax.profiler dump into a top-ops table
+    (CPU traces summarize the host plane with python frames dropped)."""
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.utils.profiling import format_summary, summarize_trace
+
+    d = str(tmp_path / "trace")
+    with jax.profiler.trace(d):
+        x = jnp.ones((128, 128))
+        for _ in range(3):
+            x = jnp.tanh(x @ x)
+        jax.block_until_ready(x)
+    rows = summarize_trace(d, top=10)
+    assert rows and all(r["total_ms"] >= 0 for r in rows)
+    assert not any(r["op"].startswith("$") for r in rows)
+    text = format_summary(rows)
+    assert "total_ms" in text and len(text.splitlines()) == len(rows) + 1

@@ -82,6 +82,18 @@ class Launcher(Logger):
                     self.info(f"profiler trace -> {self.profile_dir}")
                 except Exception as exc:  # noqa: BLE001
                     self.warning(f"profiler trace failed: {exc!r}")
+                else:
+                    # the trace is on disk either way — a summary failure
+                    # must not read as a broken trace
+                    try:
+                        from znicz_tpu.utils.profiling import (
+                            format_summary, summarize_trace)
+                        self.info("top ops by device time:\n" +
+                                  format_summary(summarize_trace(
+                                      self.profile_dir, top=15)))
+                    except Exception as exc:  # noqa: BLE001
+                        self.warning(
+                            f"trace summary unavailable: {exc!r}")
             signal.signal(signal.SIGINT, prev)
             self.workflow.stop()
         self.info("timing:\n" + self.workflow.timing_table())

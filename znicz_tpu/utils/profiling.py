@@ -1,0 +1,82 @@
+"""Profiler-trace summarization — the read side of the ``--profile``
+flag (SURVEY.md §6.1: the reference's per-unit timing table is kept, and
+``jax.profiler`` traces are the TPU-native upgrade; this module turns a
+trace directory into the "top ops by device time" table you would
+otherwise need a TensorBoard UI for — unavailable in headless runs).
+
+Parses the ``.xplane.pb`` files ``jax.profiler.trace`` writes.  Device
+planes (``/device:...``) hold XLA op timings; without one (CPU traces)
+the host plane is summarized instead, with Python-frame events dropped.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+
+
+def _newest_run_files(logdir: str) -> list[str]:
+    """All .xplane.pb files of the NEWEST run directory (a multi-host
+    trace writes one file per host under the same run dir — summarizing
+    a single file would silently show one arbitrary host)."""
+    files = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not files:
+        return []
+    by_run: dict[str, list[str]] = collections.defaultdict(list)
+    for f in files:
+        by_run[os.path.dirname(f)].append(f)
+    newest = max(by_run, key=lambda d: max(os.path.getmtime(f)
+                                           for f in by_run[d]))
+    return sorted(by_run[newest])
+
+
+def summarize_trace(logdir: str, top: int = 25) -> list[dict]:
+    """-> rows ``{"op", "total_ms", "count"}`` sorted by total device
+    time, aggregated over all hosts/devices of the newest trace run
+    under ``logdir``."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:                        # pragma: no cover
+        raise RuntimeError(
+            "trace summarization needs the tensorflow profiler protos "
+            "(tensorflow.tsl.profiler.protobuf.xplane_pb2)")
+    files = _newest_run_files(logdir)
+    if not files:
+        raise FileNotFoundError(f"no .xplane.pb under {logdir!r} — pass "
+                                f"the directory given to --profile")
+    agg: dict[str, list] = collections.defaultdict(lambda: [0, 0])
+    for path in files:
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        device_planes = [p for p in space.planes if "/device:" in p.name]
+        host_planes = [p for p in space.planes
+                       if p.name.startswith("/host:") and p.lines]
+        for plane in device_planes or host_planes:
+            meta = plane.event_metadata
+            for line in plane.lines:
+                for ev in line.events:
+                    name = meta[ev.metadata_id].name
+                    if name.startswith("$"):   # python frame (host plane)
+                        continue
+                    entry = agg[name]
+                    entry[0] += ev.duration_ps
+                    entry[1] += 1
+    rows = [{"op": op, "total_ms": ps / 1e9, "count": count}
+            for op, (ps, count) in agg.items()]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows[:top]
+
+
+def format_summary(rows: list[dict]) -> str:
+    """Rows -> aligned text table (logged by the Launcher after a
+    profiled run)."""
+    if not rows:
+        return "(empty trace)"
+    width = max(len(r["op"]) for r in rows)
+    lines = [f"{'total_ms':>10}  {'count':>7}  op"]
+    lines += [f"{r['total_ms']:10.3f}  {r['count']:7d}  "
+              f"{r['op']:<{width}}" for r in rows]
+    return "\n".join(lines)
