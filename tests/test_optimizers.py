@@ -322,3 +322,98 @@ def test_shard_update_snapshot_restores_across_layouts(tmp_path,
     got = [np.asarray(f.weights.map_read()).copy() for f in w_b.forwards]
     for a, b in zip(got, want):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_clip_norm_matches_manual_oracle():
+    """Global-norm clipping: one fused SGD step (zero momentum) equals
+    w - lr * clip(g_mean); a huge threshold is a no-op."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(clip):
+        prng.seed_all(91)
+        return StandardWorkflow(
+            name="ClipWf", loss_function="softmax", layers=[
+                {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.1, "learning_rate_bias": 0.1,
+                        "gradient_moment": 0.0,
+                        "gradient_moment_bias": 0.0}},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.1, "learning_rate_bias": 0.1,
+                        "gradient_moment": 0.0,
+                        "gradient_moment_bias": 0.0}}],
+            loader_name="synthetic_classifier",
+            loader_config={"n_classes": 4, "sample_shape": (6,),
+                           "n_train": 40, "n_valid": 0,
+                           "minibatch_size": 40},
+            decision_config={"max_epochs": 1}, clip_norm=clip)
+
+    results = {}
+    for clip in (0.5, 1e9):
+        w = build(clip)
+        w.initialize(device=TPUDevice())
+        step = w.step
+        w.loader.run()
+        idx = np.maximum(np.asarray(w.loader.minibatch_indices.mem), 0)
+        x0 = np.asarray(w.loader.original_data.mem)[idx]
+        y0 = np.asarray(w.loader.original_labels.mem)[idx]
+        p0 = [{k: np.asarray(jax.device_get(v))
+               for k, v in leaf.items()} for leaf in step._params]
+        step.run()
+        p1 = [{k: np.asarray(jax.device_get(v))
+               for k, v in leaf.items()} for leaf in step._params]
+        results[clip] = (p0, p1, x0, y0, step)
+
+    p0, p1, x0, y0, step = results[0.5]
+    trainable = [{k: jnp.asarray(l[k]) for k in ("w", "b")} for l in p0]
+
+    def loss_fn(ps):
+        out, lt = step._forward_chain(ps, jnp.asarray(x0), train=True)
+        loss, _ = step._loss_and_metrics(out, lt, jnp.asarray(y0),
+                                         jnp.ones(len(x0), bool))
+        return loss / len(x0)
+
+    grads = jax.grad(loss_fn)(trainable)
+    gnorm = float(jnp.sqrt(sum(jnp.sum(g * g)
+                               for l in grads for g in l.values())))
+    assert gnorm > 0.5          # threshold actually binds
+    scale = 0.5 / gnorm
+    for li, leaf in enumerate(grads):
+        for k in ("w", "b"):
+            want = p0[li][k] - 0.1 * scale * np.asarray(leaf[k])
+            np.testing.assert_allclose(p1[li][k], want, rtol=1e-5,
+                                       atol=1e-7, err_msg=f"{li}.{k}")
+    # huge threshold: same update as the raw gradient
+    p0u, p1u, _, _, _ = results[1e9]
+    for li, leaf in enumerate(grads):
+        for k in ("w", "b"):
+            want = p0u[li][k] - 0.1 * np.asarray(leaf[k])
+            np.testing.assert_allclose(p1u[li][k], want, rtol=1e-5,
+                                       atol=1e-7)
+
+
+def test_clip_norm_requires_fused():
+    with pytest.raises(ValueError, match="clip_norm requires fused"):
+        StandardWorkflow(
+            name="x", loss_function="softmax",
+            layers=[{"type": "softmax",
+                     "->": {"output_sample_shape": 3}}],
+            loader_name="synthetic_classifier",
+            loader_config={"n_classes": 3, "sample_shape": (4,),
+                           "n_train": 30, "n_valid": 0,
+                           "minibatch_size": 30},
+            decision_config={"max_epochs": 1}, fused=False, clip_norm=1.0)
+
+
+def test_clip_norm_rejects_nonpositive():
+    for bad in (0.0, -1.0):
+        with pytest.raises(ValueError, match="must be positive"):
+            StandardWorkflow(
+                name="x", loss_function="softmax",
+                layers=[{"type": "softmax",
+                         "->": {"output_sample_shape": 3}}],
+                loader_name="synthetic_classifier",
+                loader_config={"n_classes": 3, "sample_shape": (4,),
+                               "n_train": 30, "n_valid": 0,
+                               "minibatch_size": 30},
+                decision_config={"max_epochs": 1}, clip_norm=bad)

@@ -81,7 +81,8 @@ class FusedTrainStep(Unit):
                  scan_epoch: Optional[bool] = None,
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
-                 shard_update: bool = False, **kwargs) -> None:
+                 shard_update: bool = False,
+                 clip_norm: Optional[float] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         if optimizer not in self.OPTIMIZERS:
             raise ValueError(f"unknown optimizer {optimizer!r}; "
@@ -93,6 +94,10 @@ class FusedTrainStep(Unit):
         #: sharded — the memory win), and updated params all-gather back.
         #: Numerically equivalent to the replicated update.
         self.shard_update = bool(shard_update)
+        #: global-norm gradient clipping (None = off): the batch-mean
+        #: gradient across ALL layers is rescaled to at most this L2
+        #: norm before the optimizer applies it (standard global clip)
+        self.clip_norm = clip_norm
         #: "sgd" (reference semantics: momentum folded into the gd units'
         #: gradient buffers) or "adam" (AdamW, beyond-reference; lr and
         #: weight decay still come from the gd units' hyperparams, so LR
@@ -376,6 +381,17 @@ class FusedTrainStep(Unit):
             loss_fn, has_aux=True)(trainable)
         bs = jax.lax.psum(mask.sum(), "data")
         metrics["bs"] = bs
+        if self.clip_norm is not None:
+            # clip the batch-mean gradient's GLOBAL norm across layers;
+            # scaling grad_sum by the same factor is equivalent and keeps
+            # the downstream /bs convention untouched
+            sq = sum(jnp.sum(jnp.square(g / bs))
+                     for leaf in grads for g in leaf.values())
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.minimum(1.0, self.clip_norm /
+                                jnp.maximum(gnorm, 1e-12))
+            grads = [{k: v * scale for k, v in leaf.items()}
+                     for leaf in grads]
         # SGD backend: XLA-fused by default; the Pallas single-HBM-pass
         # kernel when root.common.engine.pallas is set (SURVEY.md §3.2
         # "fused SGD-update" kernel parity deliverable)

@@ -127,6 +127,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
                  shard_update: bool = False,
+                 clip_norm: Optional[float] = None,
                  **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
@@ -143,12 +144,22 @@ class StandardWorkflow(StandardWorkflowBase):
         self.optimizer_config = optimizer_config
         #: ZeRO-style sharded weight update over the data axis
         self.shard_update = shard_update
+        #: global-norm gradient clipping (fused step)
+        self.clip_norm = clip_norm
         if optimizer != "sgd" and not fused:
             raise ValueError(f"optimizer {optimizer!r} requires fused=True "
                              f"(the eager gd units implement SGD only)")
         if shard_update and not fused:
             raise ValueError("shard_update requires fused=True (the eager "
                              "gd units keep fully replicated state)")
+        if clip_norm is not None and not fused:
+            raise ValueError("clip_norm requires fused=True (the eager gd "
+                             "units apply per-unit updates with no global "
+                             "gradient view)")
+        if clip_norm is not None and clip_norm <= 0:
+            raise ValueError(f"clip_norm must be positive, got {clip_norm}"
+                             f" (0 freezes training; negative flips the "
+                             f"gradient sign)")
         self.snapshotter = None
         self.create_workflow()
 
@@ -238,7 +249,8 @@ class StandardWorkflow(StandardWorkflowBase):
             gds=self.gds, loader=self.loader, mesh=self.mesh,
             defer_metrics=self.defer_metrics, optimizer=self.optimizer,
             optimizer_config=self.optimizer_config,
-            shard_update=self.shard_update, name="FusedStep")
+            shard_update=self.shard_update, clip_norm=self.clip_norm,
+            name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control

@@ -56,7 +56,13 @@ def summarize_trace(logdir: str, top: int = 25) -> list[dict]:
                        if p.name.startswith("/host:") and p.lines]
         for plane in device_planes or host_planes:
             meta = plane.event_metadata
-            for line in plane.lines:
+            # TPU device planes carry separate lines for per-op timings
+            # and whole-module/step ENVELOPE events; summing envelopes in
+            # with ops would put a ~total-device-time row on top of the
+            # table.  Restrict to the op line when one exists.
+            op_lines = [ln for ln in plane.lines
+                        if "ops" in ln.name.lower()]
+            for line in op_lines or plane.lines:
                 for ev in line.events:
                     name = meta[ev.metadata_id].name
                     if name.startswith("$"):   # python frame (host plane)
