@@ -417,3 +417,68 @@ def test_clip_norm_rejects_nonpositive():
                                "n_train": 30, "n_valid": 0,
                                "minibatch_size": 30},
                 decision_config={"max_epochs": 1}, clip_norm=bad)
+
+
+def _accum_build(minibatch, accumulate, optimizer="sgd", n_train=64,
+                 max_epochs=3):
+    prng.seed_all(61)
+    return StandardWorkflow(
+        name="AccWf", loss_function="softmax", layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+             "<-": {"learning_rate": 0.05, "learning_rate_bias": 0.05,
+                    "gradient_moment": 0.9, "gradient_moment_bias": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.05, "learning_rate_bias": 0.05,
+                    "gradient_moment": 0.9, "gradient_moment_bias": 0.9}}],
+        loader_name="synthetic_classifier",
+        loader_config={"n_classes": 4, "sample_shape": (6,),
+                       "n_train": n_train, "n_valid": 0,
+                       "minibatch_size": minibatch, "shuffle_limit": 0},
+        decision_config={"max_epochs": max_epochs}, optimizer=optimizer,
+        accumulate_steps=accumulate)
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_accumulation_matches_big_minibatch(optimizer):
+    """Accumulating 4 minibatches of 16 applies the same updates as one
+    minibatch of 64 over the same (unshuffled) data — summed grads and
+    sample counts are identical, so the trajectories match."""
+    import jax
+
+    weights = {}
+    for minibatch, accumulate in ((64, 1), (16, 4)):
+        w = _accum_build(minibatch, accumulate, optimizer)
+        w.initialize(device=TPUDevice())
+        w.run()
+        w.step.sync_to_units()
+        weights[(minibatch, accumulate)] = [
+            np.asarray(f.weights.map_read()).copy() for f in w.forwards]
+        assert w.step._grad_acc is None       # no dangling accumulation
+    for a, b in zip(weights[(64, 1)], weights[(16, 4)]):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6,
+                                   err_msg=optimizer)
+
+
+def test_accumulation_ragged_tail_applies_at_epoch_end():
+    """A train pass shorter than accumulate_steps still applies its
+    gradients at the pass boundary (no leak into the next epoch)."""
+    w = _accum_build(16, 4, n_train=48, max_epochs=4)
+    w.initialize(device=TPUDevice())
+    w.run()
+    assert w.step._grad_acc is None
+    hist = [h["metric_train"] for h in w.decision.metrics_history]
+    assert hist[-1] < hist[0], hist
+
+
+def test_accumulation_requires_fused():
+    with pytest.raises(ValueError, match="accumulate_steps requires"):
+        StandardWorkflow(
+            name="x", loss_function="softmax",
+            layers=[{"type": "softmax",
+                     "->": {"output_sample_shape": 3}}],
+            loader_name="synthetic_classifier",
+            loader_config={"n_classes": 3, "sample_shape": (4,),
+                           "n_train": 30, "n_valid": 0,
+                           "minibatch_size": 30},
+            decision_config={"max_epochs": 1}, fused=False,
+            accumulate_steps=2)

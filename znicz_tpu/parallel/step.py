@@ -82,11 +82,21 @@ class FusedTrainStep(Unit):
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
                  shard_update: bool = False,
-                 clip_norm: Optional[float] = None, **kwargs) -> None:
+                 clip_norm: Optional[float] = None,
+                 accumulate_steps: int = 1, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         if optimizer not in self.OPTIMIZERS:
             raise ValueError(f"unknown optimizer {optimizer!r}; "
                              f"registered: {self.OPTIMIZERS}")
+        if accumulate_steps < 1:
+            raise ValueError(f"accumulate_steps must be >= 1, got "
+                             f"{accumulate_steps}")
+        #: gradient accumulation: apply the optimizer every N train
+        #: minibatches on the summed gradients — effective batch N x
+        #: minibatch without the activation memory of a bigger batch.
+        #: Per-minibatch metrics still publish every run; clipping (and
+        #: the adam step count) applies per EFFECTIVE batch.
+        self.accumulate_steps = int(accumulate_steps)
         #: ZeRO-style cross-replica sharding of the weight update (Xu et
         #: al. 2020, arXiv:2004.13336): gradients reduce-scatter over the
         #: ``data`` axis, each replica updates only its 1/n shard of the
@@ -140,6 +150,12 @@ class FusedTrainStep(Unit):
         self._scan_idx_fns = {}   # "train"/"eval" -> class-pass scan fn
         self._scan_in_flight = False  # current class pass was scan-dispatched
         self._scan_fn = None      # lazily-built K-step lax.scan variant
+        self._grad_fn = None      # accumulation: grads-only half-step
+        self._grad_fn_idx = None
+        self._apply_fn = None     # accumulation: deferred optimizer apply
+        self._grad_acc = None     # device-side summed grads
+        self._bs_acc = None       # device-side summed sample count
+        self._acc_count = 0       # minibatches since last apply
         self._hyper_cache = None  # (signature, device pytree)
         self._acc = None          # device-side metric sums (deferred mode)
         # metrics the Decision links to (mirrors the evaluator's attrs)
@@ -354,33 +370,20 @@ class FusedTrainStep(Unit):
     # -- compiled step bodies ------------------------------------------------
     def _local_train(self, params, key, hyper, x, labels, mask):
         """One step: ``(params, key, ...) -> (params', key', metrics)``.
-        The key is split ON DEVICE — the host never mints per-step keys."""
-        key, sub = jax.random.split(key)
-        # decorrelate dropout/stochastic masks across data shards
-        rng = jax.random.fold_in(sub, jax.lax.axis_index("data"))
-        # differentiate only the trainable leaves — the momentum buffers
-        # vw/vb never enter the loss and would otherwise get same-shaped
-        # zero cotangents materialized every step
-        trainable = [{k: v for k, v in leaf.items() if k in ("w", "b")}
-                     for leaf in params]
+        The key is split ON DEVICE — the host never mints per-step keys.
+        Gradient computation is shared with the accumulation half-step
+        (_local_grads); the optimizer application with the deferred apply
+        (_apply_update)."""
+        key, grads, metrics = self._local_grads(params, key, x, labels,
+                                                mask)
+        new_params = self._apply_update(params, grads, hyper,
+                                        metrics["bs"])
+        return new_params, key, metrics
 
-        def loss_fn(ps):
-            out, logits_tail = self._forward_chain(ps, x, train=True,
-                                                   rng=rng)
-            loss, metrics = self._loss_and_metrics(
-                out, logits_tail, labels, mask)
-            metrics = jax.lax.psum(metrics, "data")
-            # the gradient plane: differentiating through this psum makes AD
-            # itself produce the globally-summed gradient of the replicated
-            # params — one ICI collective replacing the reference's whole
-            # ZeroMQ weight-shipping protocol.  (Do NOT psum the grads again
-            # outside: replicated-input cotangents are already reduced.)
-            return jax.lax.psum(loss, "data"), metrics
-
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(trainable)
-        bs = jax.lax.psum(mask.sum(), "data")
-        metrics["bs"] = bs
+    def _apply_update(self, params, grads, hyper, bs):
+        """Apply one optimizer step for summed gradients ``grads`` over
+        ``bs`` total samples — shared by the per-minibatch step and the
+        gradient-accumulation apply."""
         if self.clip_norm is not None:
             # clip the batch-mean gradient's GLOBAL norm across layers;
             # scaling grad_sum by the same factor is equivalent and keeps
@@ -490,7 +493,34 @@ class FusedTrainStep(Unit):
                         leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
                         h["wd_b"], h["l1"], h["mom_b"], bs)
             new_params.append(new)
-        return new_params, key, metrics
+        return new_params
+
+    def _local_grads(self, params, key, x, labels, mask):
+        """Gradient-accumulation half-step: summed grads + metrics, NO
+        update (the apply happens every ``accumulate_steps`` runs)."""
+        key, sub = jax.random.split(key)
+        rng = jax.random.fold_in(sub, jax.lax.axis_index("data"))
+        trainable = [{k: v for k, v in leaf.items() if k in ("w", "b")}
+                     for leaf in params]
+
+        def loss_fn(ps):
+            out, logits_tail = self._forward_chain(ps, x, train=True,
+                                                   rng=rng)
+            loss, metrics = self._loss_and_metrics(
+                out, logits_tail, labels, mask)
+            metrics = jax.lax.psum(metrics, "data")
+            return jax.lax.psum(loss, "data"), metrics
+
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        metrics["bs"] = jax.lax.psum(mask.sum(), "data")
+        return key, grads, metrics
+
+    def _local_grads_idx(self, params, key, data, labels, idx, mask):
+        return self._local_grads(params, key, data[idx], labels[idx], mask)
+
+    def _local_apply(self, params, hyper, grads, bs):
+        return self._apply_update(params, grads, hyper, bs)
 
     def _local_eval(self, params, x, labels, mask):
         out, logits_tail = self._forward_chain(params, x, train=False)
@@ -553,6 +583,16 @@ class FusedTrainStep(Unit):
         donate = (0, 1) if self.donate else ()
         self._train_fn = jax.jit(train, donate_argnums=donate)
         self._eval_fn = jax.jit(evalf)
+        if self.accumulate_steps > 1:
+            gradf = shard_map(self._local_grads, mesh=self.mesh,
+                              in_specs=(pspecs, rep, sh, sh, sh),
+                              out_specs=(rep, rep, rep))
+            applyf = shard_map(self._local_apply, mesh=self.mesh,
+                               in_specs=(pspecs, rep, rep, rep),
+                               out_specs=pspecs)
+            self._grad_fn = jax.jit(gradf)
+            self._apply_fn = jax.jit(
+                applyf, donate_argnums=(0,) if self.donate else ())
         self._pin_dataset()
         self.initialized = True
 
@@ -595,12 +635,20 @@ class FusedTrainStep(Unit):
         donate = (0, 1) if self.donate else ()
         self._train_fn_idx = jax.jit(train, donate_argnums=donate)
         self._eval_fn_idx = jax.jit(evalf)
+        if self.accumulate_steps > 1:
+            gradf = shard_map(self._local_grads_idx, mesh=self.mesh,
+                              in_specs=(pspecs, rep, rep, rep, sh, sh),
+                              out_specs=(rep, rep, rep))
+            self._grad_fn_idx = jax.jit(gradf)
         # the loader now only needs to serve indices — its per-step host
         # gather + device upload of the minibatch would be dead work
         loader.serve_indices_only = True
         if self.scan_epoch is None:
             self.scan_epoch = bool(root.common.engine.get("scan_epoch",
                                                           False))
+        if self.scan_epoch and self.accumulate_steps > 1:
+            raise ValueError("accumulate_steps > 1 is a per-minibatch "
+                             "mode; disable scan_epoch to use it")
         if self.scan_epoch:
             self._build_scan_idx_fns()
 
@@ -671,6 +719,10 @@ class FusedTrainStep(Unit):
         pipeline stages them on device, the compiled program loops.  This
         is the hot path for ms-scale steps, where per-step host dispatch
         latency would otherwise dominate."""
+        if self.accumulate_steps > 1:
+            raise ValueError("train_steps (K-step scan) applies the "
+                             "optimizer per minibatch; accumulate_steps "
+                             "> 1 requires the per-minibatch run() path")
         if self._scan_fn is None:
             self._build_scan_fn()
         self._params, self._key, metrics = self._scan_fn(
@@ -689,18 +741,23 @@ class FusedTrainStep(Unit):
         # through to the per-minibatch path for the remainder; _acc is
         # NOT a valid in-flight marker because that path sets it too)
         mask = loader.minibatch_indices.mem >= 0
+        accumulate = self.accumulate_steps > 1
         if self._dataset_dev is not None:
             # index-fed hot path: dataset already on HBM
             idx = np.maximum(loader.minibatch_indices.mem, 0).astype(
                 np.int32)
             data, labels_all = self._dataset_dev
-            if int(loader.minibatch_class) == TRAIN:
+            if int(loader.minibatch_class) != TRAIN:
+                metrics = self._eval_fn_idx(self._params, data, labels_all,
+                                            idx, mask)
+            elif accumulate:
+                self._key, grads, metrics = self._grad_fn_idx(
+                    self._params, self._key, data, labels_all, idx, mask)
+                self._accumulate(grads, metrics, loader)
+            else:
                 self._params, self._key, metrics = self._train_fn_idx(
                     self._params, self._key, self._hyper_device(),
                     data, labels_all, idx, mask)
-            else:
-                metrics = self._eval_fn_idx(self._params, data, labels_all,
-                                            idx, mask)
             self._finish_run(loader, metrics)
             return
         x = loader.minibatch_data.mem
@@ -708,13 +765,39 @@ class FusedTrainStep(Unit):
             labels = loader.minibatch_targets.mem
         else:
             labels = loader.minibatch_labels.mem
-        if int(loader.minibatch_class) == TRAIN:
+        if int(loader.minibatch_class) != TRAIN:
+            metrics = self._eval_fn(self._params, x, labels, mask)
+        elif accumulate:
+            self._key, grads, metrics = self._grad_fn(
+                self._params, self._key, x, labels, mask)
+            self._accumulate(grads, metrics, loader)
+        else:
             self._params, self._key, metrics = self._train_fn(
                 self._params, self._key, self._hyper_device(),
                 x, labels, mask)
-        else:
-            metrics = self._eval_fn(self._params, x, labels, mask)
         self._finish_run(loader, metrics)
+
+    def _accumulate(self, grads, metrics, loader) -> None:
+        """Fold one half-step's summed grads into the device accumulator;
+        apply the optimizer every ``accumulate_steps`` train minibatches
+        and at the END of a train pass (a ragged tail must not leak into
+        the next epoch's first effective batch)."""
+        bs = metrics["bs"]
+        if self._grad_acc is None:
+            self._grad_acc = grads
+            self._bs_acc = bs
+        else:
+            self._grad_acc = jax.tree.map(jnp.add, self._grad_acc, grads)
+            self._bs_acc = self._bs_acc + bs
+        self._acc_count += 1
+        if self._acc_count >= self.accumulate_steps or \
+                loader.last_minibatch:
+            self._params = self._apply_fn(
+                self._params, self._hyper_device(), self._grad_acc,
+                self._bs_acc)
+            self._grad_acc = None
+            self._bs_acc = None
+            self._acc_count = 0
 
     def _run_scanned_class(self, loader) -> None:
         """Epoch-scan mode: the FIRST minibatch of a class pass dispatches

@@ -128,6 +128,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  optimizer_config: Optional[dict] = None,
                  shard_update: bool = False,
                  clip_norm: Optional[float] = None,
+                 accumulate_steps: int = 1,
                  **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
@@ -146,6 +147,8 @@ class StandardWorkflow(StandardWorkflowBase):
         self.shard_update = shard_update
         #: global-norm gradient clipping (fused step)
         self.clip_norm = clip_norm
+        #: gradient accumulation: optimizer applies every N minibatches
+        self.accumulate_steps = accumulate_steps
         if optimizer != "sgd" and not fused:
             raise ValueError(f"optimizer {optimizer!r} requires fused=True "
                              f"(the eager gd units implement SGD only)")
@@ -156,6 +159,8 @@ class StandardWorkflow(StandardWorkflowBase):
             raise ValueError("clip_norm requires fused=True (the eager gd "
                              "units apply per-unit updates with no global "
                              "gradient view)")
+        if accumulate_steps > 1 and not fused:
+            raise ValueError("accumulate_steps requires fused=True")
         if clip_norm is not None and clip_norm <= 0:
             raise ValueError(f"clip_norm must be positive, got {clip_norm}"
                              f" (0 freezes training; negative flips the "
@@ -250,7 +255,7 @@ class StandardWorkflow(StandardWorkflowBase):
             defer_metrics=self.defer_metrics, optimizer=self.optimizer,
             optimizer_config=self.optimizer_config,
             shard_update=self.shard_update, clip_norm=self.clip_norm,
-            name="FusedStep")
+            accumulate_steps=self.accumulate_steps, name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control
