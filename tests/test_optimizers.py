@@ -482,3 +482,27 @@ def test_accumulation_requires_fused():
                            "minibatch_size": 30},
             decision_config={"max_epochs": 1}, fused=False,
             accumulate_steps=2)
+
+
+def test_accumulation_composes_with_shard_update(cpu_devices):
+    """accumulate_steps + ZeRO shard_update trains identically to
+    accumulate_steps with the replicated update."""
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+    from znicz_tpu.parallel.step import FusedTrainStep
+
+    weights = {}
+    for shard in (False, True):
+        prng.seed_all(41)
+        w = build_fused(max_epochs=3, layers=(16,), minibatch_size=16,
+                        n_train=64, n_valid=0,
+                        mesh=data_parallel_mesh(8), optimizer="adam",
+                        shard_update=shard, accumulate_steps=2)
+        w.initialize(device=TPUDevice())
+        w.run()
+        w.step.sync_to_units()
+        assert w.step._grad_acc is None
+        weights[shard] = [np.asarray(f.weights.map_read()).copy()
+                          for f in w.forwards]
+    for a, b in zip(weights[True], weights[False]):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
