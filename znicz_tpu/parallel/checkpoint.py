@@ -1,0 +1,43 @@
+"""Orbax-backed checkpointing for SPMD parameter pytrees (the
+transformer / pipeline model family, whose params are user-managed
+pytrees rather than workflow unit Arrays).
+
+The workflow world keeps its own array-based snapshotter
+(znicz_tpu/snapshotter.py: bit-exact resume, loader/PRNG/decision
+state); this module covers the functional world with the TPU-ecosystem
+standard (orbax), including restore onto a different mesh — the target
+sharding is taken from the abstract target tree, so a checkpoint written
+on one mesh loads sharded for another.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def save_pytree(path: str, params) -> str:
+    """Write ``params`` (any pytree of arrays) under ``path`` (a
+    directory; created/overwritten atomically by orbax)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckpt:
+        ckpt.save(path, params, force=True)
+    return path
+
+
+def load_pytree(path: str, like=None):
+    """Load a pytree checkpoint.  ``like`` (optional) is a template
+    pytree — restored arrays adopt its shardings/dtypes, which is how a
+    checkpoint written on one mesh restores onto another."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckpt:
+        if like is None:
+            return ckpt.restore(path)
+        target = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x), like)
+        return ckpt.restore(path, target)
