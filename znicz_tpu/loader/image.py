@@ -29,6 +29,9 @@ from znicz_tpu.loader.normalization import (NormalizerStateMixin,
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
 
+#: bump when the synthesis recipe changes — stale cached trees regenerate
+SYNTH_VERSION = "1"
+
 
 def _decode(path: str, sample_shape: tuple) -> np.ndarray:
     """Read + resize one image file to (H, W, C) float32 in [0, 255]."""
@@ -91,6 +94,38 @@ def synthesize_image_dataset(data_dir: str, n_classes: int = 8,
                 gen.normal(0.0, 0.10, mean.shape).astype(np.float32)
             arr = (np.clip(img, 0, 1) * 255).astype(np.uint8)
             Image.fromarray(arr).save(os.path.join(sub, f"{i:04d}.png"))
+    # completion marker, written LAST: its presence certifies the whole
+    # tree (ensure_image_tree keys regeneration off it)
+    with open(os.path.join(data_dir, ".synth_version"), "w") as f:
+        f.write(SYNTH_VERSION)
+
+
+def ensure_image_tree(data_dir: str, **synth_kwargs) -> str:
+    """Return ``data_dir``, synthesizing the stand-in tree when needed.
+
+    Regeneration contract (shared with the text/mnist loaders): a
+    missing/empty directory is synthesized into a temp sibling and
+    renamed into place (a torn synthesis never becomes visible); a tree
+    carrying a stale ``.synth_version`` marker is rebuilt; a non-empty
+    tree WITHOUT the marker is user data and is never touched."""
+    import shutil
+
+    vfile = os.path.join(data_dir, ".synth_version")
+    populated = os.path.isdir(data_dir) and bool(os.listdir(data_dir))
+    if populated:
+        if not os.path.exists(vfile):
+            return data_dir                       # user-supplied tree
+        if open(vfile).read().strip() == SYNTH_VERSION:
+            return data_dir                       # complete + current
+        shutil.rmtree(data_dir)                   # stale recipe: rebuild
+    tmp = data_dir.rstrip("/\\") + f".tmp{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    synthesize_image_dataset(tmp, **synth_kwargs)
+    if os.path.isdir(data_dir):                   # empty dir from makedirs
+        os.rmdir(data_dir)
+    os.replace(tmp, data_dir)
+    return data_dir
 
 
 @register_loader("file_image")
