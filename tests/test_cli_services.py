@@ -226,18 +226,19 @@ def test_vmapped_population_matches_sequential_and_scales():
                    ["n_err"])
 
     run_sequential(0)           # warm: compiles _train_fn/_eval_fn
-    seq = []
-    t_seq = 0.0
-    for i in (0, 3, 7):
+    t_seq = float("inf")
+    for _rep in range(2):       # best-of-2: robust to transient host load
         t0 = time.perf_counter()
-        seq.append(run_sequential(i))
-        t_seq += time.perf_counter() - t0
+        seq = [run_sequential(i) for i in (0, 3, 7)]
+        t_seq = min(t_seq, time.perf_counter() - t0)
     assert seq == [int(f) for f in fits[[0, 3, 7]]], (seq, fits)
 
     # scaling: one warmed batched dispatch for 8 beats 3 sequential runs
-    t0 = time.perf_counter()
-    jax.device_get(evaluator(hyper_pop, xs, ys, ms, ex, ey, em))
-    t_vmap = time.perf_counter() - t0
+    t_vmap = float("inf")
+    for _rep in range(2):
+        t0 = time.perf_counter()
+        jax.device_get(evaluator(hyper_pop, xs, ys, ms, ex, ey, em))
+        t_vmap = min(t_vmap, time.perf_counter() - t0)
     assert t_vmap < t_seq, (t_vmap, t_seq, t_vmap_cold)
 
 

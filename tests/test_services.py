@@ -250,3 +250,72 @@ def test_trace_summary_reports_top_ops(tmp_path):
     assert not any(r["op"].startswith("$") for r in rows)
     text = format_summary(rows)
     assert "total_ms" in text and len(text.splitlines()) == len(rows) + 1
+
+
+def test_manhole_repl_session():
+    """Live-REPL service (the reference's manhole): expressions echo
+    their repr, statements exec with stdout captured, errors return a
+    traceback without killing the session."""
+    import socket
+    import time
+
+    from znicz_tpu.utils.manhole import Manhole
+
+    hole = Manhole(namespace={"answer": 41}, port=0)
+    port = hole.start()
+    try:
+        conn = socket.create_connection(("127.0.0.1", port), timeout=5)
+        for line in ("answer + 1", "x = answer * 2", "print(x)", "1/0"):
+            conn.sendall(line.encode() + b"\n")
+        time.sleep(0.5)
+        out = conn.recv(65536).decode()
+        assert "manhole" in out                       # banner
+        assert "42" in out                            # expression repr
+        assert "82" in out                            # statement stdout
+        assert "ZeroDivisionError" in out             # traceback, not death
+        conn.sendall(b"answer\n")                     # session survived
+        time.sleep(0.3)
+        assert "41" in conn.recv(65536).decode()
+        conn.close()
+    finally:
+        hole.stop()
+    # teardown: listener closed, serving thread exited (a post-stop
+    # connect probe would be unsound here: connecting to a free ephemeral
+    # loopback port can self-connect on Linux)
+    assert hole._sock.fileno() == -1
+    assert not hole._thread.is_alive()
+
+
+def test_launcher_serves_manhole():
+    """Launcher with manhole_port=0 serves the live workflow namespace
+    during the run and tears it down after."""
+    import socket
+    import time
+
+    from znicz_tpu.launcher import Launcher
+    from znicz_tpu.models import wine
+
+    prng.seed_all(3)
+    launcher = Launcher(device=TPUDevice(), manhole_port=0)
+    launcher.load(wine.build, max_epochs=1, n_train=60, n_valid=30,
+                  minibatch_size=10)
+
+    # probe the manhole DURING the run, from the decision's epoch hook
+    seen = {}
+    wf = launcher.workflow
+    orig_run = wf.decision.run
+
+    def probing_run():
+        orig_run()
+        if launcher.manhole is not None and "reply" not in seen:
+            conn = socket.create_connection(
+                ("127.0.0.1", launcher.manhole.port), timeout=5)
+            conn.sendall(b"wf.name\n")
+            time.sleep(0.3)
+            seen["reply"] = conn.recv(65536).decode()
+            conn.close()
+
+    wf.decision.run = probing_run
+    launcher.main()
+    assert "Wine" in seen.get("reply", ""), seen
+    assert launcher.manhole._sock.fileno() == -1      # torn down

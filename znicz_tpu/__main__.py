@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N", help="train N seeded members of the "
                    "workflow and write an ensemble summary JSON "
                    "(reference: --ensemble-train)")
+    p.add_argument("--manhole", type=int, default=None, metavar="PORT",
+                   help="serve a live localhost REPL into the running "
+                        "workflow on PORT (0 = ephemeral; connect with "
+                        "nc 127.0.0.1 PORT)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
     p.add_argument("--publish", default=None, metavar="BACKEND",
@@ -103,10 +107,10 @@ def main(argv=None) -> int:
             print("--ensemble-train needs N >= 1", file=sys.stderr)
             return 2
         if args.publish or args.snapshot or args.profile or \
-                args.optimize is not None:
+                args.optimize is not None or args.manhole is not None:
             print("--ensemble-train cannot be combined with --publish/"
-                  "-w/--profile/--optimize (members are independent runs)",
-                  file=sys.stderr)
+                  "-w/--profile/--optimize/--manhole (members are "
+                  "independent runs)", file=sys.stderr)
             return 2
         from znicz_tpu.utils.ensemble import train_members_from_module
 
@@ -123,11 +127,17 @@ def main(argv=None) -> int:
         return 0
     launcher = Launcher(device=make_device(args.device),
                         snapshot=args.snapshot, stealth=args.stealth,
-                        profile_dir=args.profile)
+                        profile_dir=args.profile,
+                        manhole_port=args.manhole)
     if args.optimize is not None:
         if args.publish is not None:
             print("--publish cannot be combined with --optimize "
                   "(GA evaluation runs are throwaway)", file=sys.stderr)
+            return 2
+        if args.manhole is not None:
+            print("--manhole cannot be combined with --optimize "
+                  "(GA evaluation runs bypass Launcher.main)",
+                  file=sys.stderr)
             return 2
         from znicz_tpu.utils.genetics import optimize
         best = optimize(module, launcher, generations=args.optimize)

@@ -34,12 +34,17 @@ class Launcher(Logger):
     def __init__(self, device: Optional[Device] = None,
                  snapshot: Optional[str] = None,
                  stealth: bool = False,
-                 profile_dir: Optional[str] = None) -> None:
+                 profile_dir: Optional[str] = None,
+                 manhole_port: Optional[int] = None) -> None:
         super().__init__()
         self.device = device
         self.snapshot = snapshot
         #: stealth: suppress side services (plotters/web) — reference -s
         self.stealth = stealth
+        #: when set, serve a live localhost REPL into the running
+        #: workflow (0 = ephemeral port) — reference's manhole service
+        self.manhole_port = manhole_port
+        self.manhole = None
         #: when set, the run is wrapped in ``jax.profiler.trace`` and the
         #: trace lands here (open with TensorBoard / xprof — SURVEY §6.1,
         #: the TPU-native upgrade over the reference's wall-clock table)
@@ -65,13 +70,24 @@ class Launcher(Logger):
             meta = restore_state(self.workflow, self.snapshot)
             self.info(f"resumed from {self.snapshot} "
                       f"(epoch {meta['loader']['epoch_number']})")
-        prev = signal.signal(signal.SIGINT, self._on_sigint)
+        if self.manhole_port is not None:
+            # explicitly opt-in, so it is served even under --stealth
+            # (stealth suppresses the *default* side services)
+            from znicz_tpu.core.config import root
+            from znicz_tpu.utils.manhole import Manhole
+            self.manhole = Manhole(
+                namespace={"wf": self.workflow, "launcher": self,
+                           "root": root},
+                port=self.manhole_port)
+            self.manhole.start()
+        prev = None
         profiling = False
-        if self.profile_dir:
-            import jax
-            jax.profiler.start_trace(self.profile_dir)
-            profiling = True
         try:
+            prev = signal.signal(signal.SIGINT, self._on_sigint)
+            if self.profile_dir:
+                import jax
+                jax.profiler.start_trace(self.profile_dir)
+                profiling = True
             self.workflow.run()
         finally:
             if profiling:
@@ -94,7 +110,10 @@ class Launcher(Logger):
                     except Exception as exc:  # noqa: BLE001
                         self.warning(
                             f"trace summary unavailable: {exc!r}")
-            signal.signal(signal.SIGINT, prev)
+            if self.manhole is not None:
+                self.manhole.stop()
+            if prev is not None:
+                signal.signal(signal.SIGINT, prev)
             self.workflow.stop()
         self.info("timing:\n" + self.workflow.timing_table())
         return self.workflow

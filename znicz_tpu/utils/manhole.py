@@ -1,0 +1,131 @@
+"""Manhole — live REPL into a running training process (rebuild of the
+reference's vendored ``veles/external/manhole`` service, SURVEY.md §3.3
+"Misc ext": "manhole = live REPL into a running training").
+
+A background thread serves a line-oriented Python REPL on a localhost TCP
+socket; connect with ``nc 127.0.0.1 <port>`` (or telnet) while training
+runs and inspect the live workflow — ``wf.decision.metrics_history``,
+``wf.step.loss``, pause via gates, etc.  The namespace is handed in by the
+owner (Launcher passes ``wf``/``launcher``/``root``).
+
+Design points:
+- binds 127.0.0.1 ONLY (same trust model as the reference: the manhole is
+  a local debugging backdoor, never a network service);
+- expressions are evaluated and their repr written back; statements are
+  exec'd with stdout redirected to the socket; exceptions return their
+  traceback instead of killing the connection;
+- the serving thread is a daemon: an abandoned manhole never blocks
+  process exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import socket
+import threading
+import traceback
+from typing import Optional
+
+from znicz_tpu.core.logger import Logger
+
+BANNER = "znicz-tpu manhole — live namespace: %s\n"
+PROMPT = ">>> "
+
+
+class Manhole(Logger):
+    """Serve a REPL over localhost TCP in a daemon thread."""
+
+    def __init__(self, namespace: Optional[dict] = None,
+                 port: int = 0) -> None:
+        super().__init__()
+        self.namespace = dict(namespace or {})
+        self.port = port
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+
+    def start(self) -> int:
+        """Bind and serve; returns the bound port (useful with port=0)."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", self.port))
+        self._sock.listen(2)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="manhole")
+        self._thread.start()
+        self.info(f"manhole listening on 127.0.0.1:{self.port}")
+        return self.port
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._sock is not None:
+            # closing a listening socket does not reliably wake a thread
+            # blocked in accept() on Linux — shut it down first, and poke
+            # it with a throwaway connect so the acceptor observes EOF
+            with contextlib.suppress(OSError):
+                self._sock.shutdown(socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                socket.create_connection(("127.0.0.1", self.port),
+                                         timeout=0.2).close()
+            with contextlib.suppress(OSError):
+                self._sock.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- internals ----------------------------------------------------------
+    def _serve(self) -> None:
+        while not self._stopping:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return                                   # closed by stop()
+            threading.Thread(target=self._session, args=(conn,),
+                             daemon=True, name="manhole-conn").start()
+
+    def _session(self, conn: socket.socket) -> None:
+        f = conn.makefile("rw", encoding="utf-8", newline="\n")
+        try:
+            names = [n for n in sorted(self.namespace)
+                     if not n.startswith("_")]       # hide _, __builtins__
+            f.write(BANNER % ", ".join(names) + PROMPT)
+            f.flush()
+            for line in f:
+                line = line.rstrip("\r\n")
+                if line in ("exit()", "quit()", "\x04"):
+                    break
+                out = self._run(line)
+                if out:
+                    f.write(out if out.endswith("\n") else out + "\n")
+                f.write(PROMPT)
+                f.flush()
+        except (OSError, ValueError):
+            pass                                         # client went away
+        finally:
+            with contextlib.suppress(OSError):
+                f.close()
+                conn.close()
+
+    def _run(self, line: str) -> str:
+        """One REPL step: eval expressions (returning repr), exec
+        statements (returning captured stdout), tracebacks on error."""
+        if not line.strip():
+            return ""
+        buf = io.StringIO()
+        try:
+            with contextlib.redirect_stdout(buf):
+                try:
+                    code = compile(line, "<manhole>", "eval")
+                except SyntaxError:
+                    exec(compile(line, "<manhole>", "exec"), self.namespace)
+                    result = None
+                else:
+                    result = eval(code, self.namespace)  # noqa: S307
+        except Exception:  # noqa: BLE001 — REPL contract: show, don't die
+            return traceback.format_exc(limit=8)
+        text = buf.getvalue()
+        if result is not None:
+            self.namespace["_"] = result
+            text += repr(result) + "\n"
+        return text
