@@ -114,3 +114,124 @@ def test_fc_workflow_backends_agree():
     for m_np, m_x in zip(h_np.decision.metrics_history,
                          h_x.decision.metrics_history):
         assert abs(m_np["metric_validation"] - m_x["metric_validation"]) <= 2
+
+
+def test_evaluator_class_weights_scale_err_output():
+    """class_weights scales each err_output row by its TRUE class's
+    weight; n_err stays the unweighted count (reference semantics)."""
+    from znicz_tpu.core.workflow import Workflow
+
+    w = Workflow(name="cw")
+    y = np.array([[0.7, 0.2, 0.1],
+                  [0.1, 0.8, 0.1],
+                  [0.3, 0.3, 0.4]], np.float32)
+    labels = np.array([0, 2, 2], np.int32)
+    weights = np.array([1.0, 1.0, 3.0], np.float32)
+
+    def build_eval(**kw):
+        ev = EvaluatorSoftmax(w, compute_confusion_matrix=False, **kw)
+        ev.output.mem = y.copy()
+        ev.labels.mem = labels.copy()
+        ev.batch_size = 3
+        ev.initialize(device=NumpyDevice())
+        ev.run()
+        return ev
+
+    plain = build_eval()
+    weighted = build_eval(class_weights=weights)
+    scale = weights[labels][:, None]
+    np.testing.assert_allclose(weighted.err_output.mem,
+                               plain.err_output.mem * scale, rtol=1e-6)
+    assert weighted.n_err == plain.n_err == 1
+
+
+def test_class_weights_fused_matches_eager():
+    """One weighted TRAIN minibatch through the eager unit chain and the
+    fused AD step must produce identical weight updates — the class
+    weighting enters via err_output scaling in one and via the loss term
+    in the other."""
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    cw = [0.5, 2.0, 1.0]
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+         "<-": {"learning_rate": 0.1, "gradient_moment": 0.0}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.1, "gradient_moment": 0.0}},
+    ]
+    loader_cfg = {"n_classes": 3, "sample_shape": (8,), "n_train": 60,
+                  "n_valid": 0, "minibatch_size": 30, "spread": 2.0}
+
+    def one_step(fused, device):
+        prng.seed_all(123)
+        w = StandardWorkflow(
+            name="CW", layers=[dict(d) for d in layers],
+            loss_function="softmax",
+            evaluator_config={"class_weights": cw},
+            loader_name="synthetic_classifier", loader_config=loader_cfg,
+            decision_config={"max_epochs": 1}, fused=fused)
+        w.initialize(device=device)
+        w.loader.run()
+        if fused:
+            w.step.run()
+            w.step.sync_to_units()
+        else:
+            for f in w.forwards:
+                f.run()
+            w.evaluator.run()
+            for gd in reversed(w.gds):
+                gd.run()
+        return w
+
+    we = one_step(False, NumpyDevice())
+    wf = one_step(True, TPUDevice())
+    for i, (fe, ff) in enumerate(zip(we.forwards, wf.forwards)):
+        np.testing.assert_allclose(
+            ff.weights.map_read(), fe.weights.map_read(),
+            rtol=1e-4, atol=1e-5, err_msg=f"layer {i} weights")
+        np.testing.assert_allclose(
+            ff.bias.map_read(), fe.bias.map_read(),
+            rtol=1e-4, atol=1e-5, err_msg=f"layer {i} bias")
+    # and the weighting really changed the update (vs unweighted run)
+    prng.seed_all(123)
+    w0 = StandardWorkflow(
+        name="CW0", layers=[dict(d) for d in layers],
+        loss_function="softmax",
+        loader_name="synthetic_classifier", loader_config=loader_cfg,
+        decision_config={"max_epochs": 1}, fused=True)
+    w0.initialize(device=TPUDevice())
+    w0.loader.run()
+    w0.step.run()
+    w0.step.sync_to_units()
+    assert not np.allclose(w0.forwards[-1].weights.map_read(),
+                           wf.forwards[-1].weights.map_read())
+
+
+def test_class_weights_misconfiguration_fails_loudly():
+    """Wrong-length weight vectors and misplaced/typo'd evaluator_config
+    keys must raise, not train silently unweighted (XLA's clamped gather
+    would otherwise hide both)."""
+    import pytest
+
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    layers = [{"type": "softmax", "->": {"output_sample_shape": 3},
+               "<-": {"learning_rate": 0.1}}]
+    cfg = {"n_classes": 3, "sample_shape": (6,), "n_train": 30,
+           "n_valid": 0, "minibatch_size": 10}
+
+    with pytest.raises(ValueError, match="not accepted"):
+        StandardWorkflow(
+            name="bad-key", layers=[dict(d) for d in layers],
+            loss_function="softmax",
+            evaluator_config={"class_weight": [1, 1, 1]},   # typo'd key
+            loader_name="synthetic_classifier", loader_config=dict(cfg))
+
+    prng.seed_all(5)
+    w = StandardWorkflow(
+        name="bad-len", layers=[dict(d) for d in layers],
+        loss_function="softmax",
+        evaluator_config={"class_weights": [1.0, 2.0]},     # 2 for 3
+        loader_name="synthetic_classifier", loader_config=dict(cfg))
+    with pytest.raises(ValueError, match="entries"):
+        w.initialize(device=NumpyDevice())

@@ -354,7 +354,13 @@ class FusedTrainStep(Unit):
                 logp = jnp.log(jnp.clip(out, 1e-30, None))
             n = out.shape[0]
             picked = logp[jnp.arange(n), labels]
-            loss = -(picked * fmask).sum()
+            # per-class weights (evaluator contract): the CE term of each
+            # sample is scaled by its TRUE class's weight, so AD yields
+            # err_output rows scaled exactly like the eager evaluator's
+            cw = getattr(self.evaluator, "class_weights", None)
+            wrow = fmask if cw is None else \
+                fmask * jnp.asarray(cw, out.dtype)[labels]
+            loss = -(picked * wrow).sum()
             pred = out.argmax(axis=1)
             n_err = ((pred != labels) & mask).sum()
             return loss, {"loss": loss, "n_err": n_err}

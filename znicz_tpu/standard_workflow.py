@@ -120,6 +120,7 @@ class StandardWorkflow(StandardWorkflowBase):
 
     def __init__(self, workflow=None, layers=None,
                  loss_function: str = "softmax",
+                 evaluator_config: Optional[dict] = None,
                  decision_config: Optional[dict] = None,
                  snapshotter_config: Optional[dict] = None,
                  fused: bool = True, mesh=None,
@@ -134,6 +135,9 @@ class StandardWorkflow(StandardWorkflowBase):
         if loss_function not in ("softmax", "mse"):
             raise ValueError(f"unknown loss_function {loss_function!r}")
         self.loss_function = loss_function
+        #: forwarded to the evaluator constructor (e.g. class_weights,
+        #: compute_confusion_matrix, root_mse)
+        self.evaluator_config = dict(evaluator_config or {})
         self.decision_config = dict(decision_config or {})
         self.snapshotter_config = snapshotter_config
         self.fused = fused
@@ -185,17 +189,34 @@ class StandardWorkflow(StandardWorkflowBase):
         self.repeater.link_from(self._tail)
         self.link_end_point()
 
+    #: evaluator_config keys each loss accepts — the Unit base swallows
+    #: unknown kwargs, so a typo'd or misplaced key (class_weights on an
+    #: MSE workflow) would otherwise be dropped silently
+    _EVALUATOR_KEYS = {"softmax": {"compute_confusion_matrix",
+                                   "class_weights"},
+                       "mse": {"root_mse"}}
+
     def link_evaluator(self, parent: Forward) -> None:
+        unknown = set(self.evaluator_config) - \
+            self._EVALUATOR_KEYS[self.loss_function]
+        if unknown:
+            raise ValueError(
+                f"evaluator_config keys {sorted(unknown)} are not "
+                f"accepted by the {self.loss_function!r} evaluator "
+                f"(accepted: "
+                f"{sorted(self._EVALUATOR_KEYS[self.loss_function])})")
         if self.loss_function == "softmax":
             if not isinstance(self.forwards[-1], All2AllSoftmax):
                 raise ValueError('loss_function="softmax" requires the last '
                                  'layer to be of type "softmax"')
-            ev = self.evaluator = EvaluatorSoftmax(self)
+            ev = self.evaluator = EvaluatorSoftmax(self,
+                                                   **self.evaluator_config)
             ev.link_attrs(parent, "output", "max_idx")
             ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
                           ("batch_size", "minibatch_size"))
         else:
-            ev = self.evaluator = EvaluatorMSE(self)
+            ev = self.evaluator = EvaluatorMSE(self,
+                                               **self.evaluator_config)
             ev.link_attrs(parent, "output")
             ev.link_attrs(self.loader, ("target", "minibatch_targets"),
                           ("batch_size", "minibatch_size"))

@@ -44,14 +44,22 @@ class EvaluatorSoftmax(EvaluatorBase):
     produces ``err_output = y - onehot(labels)`` (d CE/d logits), and
     metrics: ``n_err`` (argmax mismatches), ``confusion_matrix``,
     ``max_err_output_sum`` (largest |err| row-sum, a divergence canary).
+
+    ``class_weights`` (length n_classes) scales each sample's err_output
+    row by the weight of its TRUE class — the reference's class-imbalance
+    compensation (EvaluatorSoftmax honors class weights; underrepresented
+    classes contribute proportionally more gradient).  ``n_err`` stays an
+    unweighted integer count, reference semantics.
     """
 
     def __init__(self, workflow=None, compute_confusion_matrix: bool = True,
-                 **kwargs) -> None:
+                 class_weights=None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.labels = Array()   # linked from loader (minibatch_labels)
         self.max_idx = Array()  # linked from All2AllSoftmax
         self.compute_confusion_matrix = compute_confusion_matrix
+        self.class_weights = None if class_weights is None else \
+            np.asarray(class_weights, np.float32)
         self.n_err = 0
         self.confusion_matrix = None
         self.max_err_output_sum = 0.0
@@ -59,16 +67,25 @@ class EvaluatorSoftmax(EvaluatorBase):
     def _common_init(self, **kwargs) -> None:
         super()._common_init(**kwargs)
         n_classes = self.output.shape[1]
+        if self.class_weights is not None and \
+                len(self.class_weights) != n_classes:
+            # XLA's clamped gather would otherwise train silently with
+            # the wrong weighting on a length mismatch
+            raise ValueError(
+                f"class_weights has {len(self.class_weights)} entries "
+                f"for {n_classes} classes")
         if self.compute_confusion_matrix:
             self.confusion_matrix = np.zeros((n_classes, n_classes), np.int64)
 
     @staticmethod
-    def _compute(xp, y, labels, max_idx, batch_size):
+    def _compute(xp, y, labels, max_idx, batch_size, class_weights=None):
         """Pure path shared by both backends; returns (err, n_err, sums)."""
         n, c = y.shape
         valid = (xp.arange(n) < batch_size)
         onehot = (labels[:, None] == xp.arange(c)[None, :]).astype(y.dtype)
         err = (y - onehot) * valid[:, None].astype(y.dtype)
+        if class_weights is not None:
+            err = err * class_weights[labels][:, None].astype(y.dtype)
         n_err = xp.sum((max_idx != labels) & valid)
         max_err_sum = xp.abs(err).sum(axis=1).max()
         return err, n_err, max_err_sum
@@ -79,7 +96,8 @@ class EvaluatorSoftmax(EvaluatorBase):
         max_idx = self.max_idx.map_read() if self.max_idx else \
             y.argmax(axis=1)
         bs = self.current_batch_size(self.output)
-        err, n_err, max_err_sum = self._compute(np, y, labels, max_idx, bs)
+        err, n_err, max_err_sum = self._compute(np, y, labels, max_idx, bs,
+                                                self.class_weights)
         self.err_output.map_invalidate()
         self.err_output.mem = err
         self.n_err = int(n_err)
@@ -89,9 +107,11 @@ class EvaluatorSoftmax(EvaluatorBase):
                       (max_idx[:bs], labels[:bs]), 1)
 
     def xla_init(self) -> None:
+        cw = None if self.class_weights is None else \
+            jnp.asarray(self.class_weights)
         self._xla_fn = jax.jit(
             lambda y, labels, max_idx, bs:
-            self._compute(jnp, y, labels, max_idx, bs))
+            self._compute(jnp, y, labels, max_idx, bs, cw))
 
     def xla_run(self) -> None:
         for arr in (self.output, self.labels):
