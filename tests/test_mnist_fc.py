@@ -235,3 +235,76 @@ def test_class_weights_misconfiguration_fails_loudly():
         loader_name="synthetic_classifier", loader_config=dict(cfg))
     with pytest.raises(ValueError, match="entries"):
         w.initialize(device=NumpyDevice())
+
+
+def test_fused_confusion_matrix_matches_eager():
+    """Fused workflows tally the same per-class-pass confusion matrixes
+    the eager evaluator produces (Decision owns collection + reset)."""
+    from znicz_tpu.loader.base import TRAIN, VALID
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.0}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.0}},
+    ]
+    cfg = {"n_classes": 4, "sample_shape": (6,), "n_train": 80,
+           "n_valid": 40, "minibatch_size": 20, "spread": 1.5}
+
+    def run(fused, device):
+        prng.seed_all(44)
+        w = StandardWorkflow(
+            name="conf", layers=[dict(d) for d in layers],
+            loss_function="softmax", loader_name="synthetic_classifier",
+            loader_config=dict(cfg), decision_config={"max_epochs": 1},
+            fused=fused)
+        w.initialize(device=device)
+        w.run()
+        return w
+
+    we = run(False, NumpyDevice())
+    wf = run(True, TPUDevice())
+    for cls in (VALID, TRAIN):
+        me = we.decision.confusion_matrixes[cls]
+        mf = wf.decision.confusion_matrixes[cls]
+        assert me is not None and mf is not None
+        expected = cfg["n_train"] if cls == TRAIN else cfg["n_valid"]
+        assert me.sum() == expected
+        # column sums = per-class label counts: data-determined, exact on
+        # any backend; cell values may differ by boundary-sample flips
+        # between numpy and XLA float trajectories (precedent:
+        # test_fc_workflow_backends_agree's +/-2 tolerance)
+        np.testing.assert_array_equal(mf.sum(axis=0), me.sum(axis=0),
+                                      err_msg=f"class {cls} label counts")
+        assert np.abs(mf - me).sum() <= 4, (cls, mf, me)
+
+
+def test_fused_confusion_matrix_survives_midpass_flush():
+    """A probe calling flush_metrics() mid class pass must not
+    double-count the already-published minibatches (deferred mode keeps
+    cumulative sums; only the delta may fold in)."""
+    from znicz_tpu.loader.base import TRAIN
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    layers = [{"type": "softmax", "->": {"output_sample_shape": 3},
+               "<-": {"learning_rate": 0.05}}]
+    cfg = {"n_classes": 3, "sample_shape": (5,), "n_train": 60,
+           "n_valid": 0, "minibatch_size": 20, "spread": 2.0}
+    prng.seed_all(11)
+    w = StandardWorkflow(
+        name="flush", layers=layers, loss_function="softmax",
+        loader_name="synthetic_classifier", loader_config=dict(cfg),
+        decision_config={"max_epochs": 1}, fused=True)
+    w.initialize(device=TPUDevice())
+    # run the pass by hand, flushing after every minibatch
+    while True:
+        w.loader.run()
+        w.step.run()
+        w.step.flush_metrics()
+        w.step.flush_metrics()      # repeated probe: still no double count
+        if bool(w.loader.last_minibatch):
+            break
+    w.decision.run()
+    mat = w.decision.confusion_matrixes[TRAIN]
+    assert mat is not None and mat.sum() == cfg["n_train"], mat

@@ -158,6 +158,7 @@ class FusedTrainStep(Unit):
         self._acc_count = 0       # minibatches since last apply
         self._hyper_cache = None  # (signature, device pytree)
         self._acc = None          # device-side metric sums (deferred mode)
+        self._conf_seen = None    # confusion sums already folded this pass
         # metrics the Decision links to (mirrors the evaluator's attrs)
         self.n_err = 0
         self.mse = 0.0
@@ -363,7 +364,18 @@ class FusedTrainStep(Unit):
             loss = -(picked * wrow).sum()
             pred = out.argmax(axis=1)
             n_err = ((pred != labels) & mask).sum()
-            return loss, {"loss": loss, "n_err": n_err}
+            metrics = {"loss": loss, "n_err": n_err}
+            if getattr(self.evaluator, "compute_confusion_matrix", False):
+                # (pred, label) count matrix as f32 sums — exact up to
+                # 2^24 samples per class pass, far above any epoch here;
+                # orientation matches the eager evaluator's
+                # np.add.at(confusion, (max_idx, labels), 1)
+                c = out.shape[1]
+                pred_oh = jax.nn.one_hot(pred, c, dtype=jnp.float32) * \
+                    fmask[:, None]
+                lab_oh = jax.nn.one_hot(labels, c, dtype=jnp.float32)
+                metrics["confusion"] = pred_oh.T @ lab_oh
+            return loss, metrics
         if isinstance(self.evaluator, EvaluatorMSE):
             n = out.shape[0]
             diff = (out.reshape(n, -1) -
@@ -826,8 +838,9 @@ class FusedTrainStep(Unit):
             self._acc = metrics
             self._scan_in_flight = True
         if loader.last_minibatch:
-            self._publish(jax.device_get(self._acc))
+            self._publish(jax.device_get(self._acc), cumulative=True)
             self._acc = None
+            self._conf_seen = None
             self._scan_in_flight = False
         else:
             self.n_err = 0
@@ -844,8 +857,9 @@ class FusedTrainStep(Unit):
         self._acc = metrics if self._acc is None else \
             jax.tree.map(jnp.add, self._acc, metrics)
         if loader.last_minibatch:
-            self._publish(jax.device_get(self._acc))
+            self._publish(jax.device_get(self._acc), cumulative=True)
             self._acc = None
+            self._conf_seen = None
         else:
             # non-final minibatches contribute zero to the Decision's
             # accumulators; the class-pass totals land in one shot above
@@ -854,8 +868,13 @@ class FusedTrainStep(Unit):
             self.loss = 0.0
             self.minibatch_size = 0
 
-    def _publish(self, sums) -> None:
-        """Write (host) metric sums into the attrs the Decision reads."""
+    def _publish(self, sums, cumulative: bool = False) -> None:
+        """Write (host) metric sums into the attrs the Decision reads.
+
+        ``cumulative=True`` marks sums that cover the class pass SO FAR
+        (the deferred/scan accumulator) rather than one minibatch — the
+        confusion matrix folds only the delta since the last publish, so
+        a mid-pass ``flush_metrics`` never double-counts."""
         bs = float(sums["bs"])
         self.minibatch_size = int(bs)
         self.loss = float(sums["loss"])
@@ -863,6 +882,18 @@ class FusedTrainStep(Unit):
             self.n_err = int(sums["n_err"])
         if "mse_sum" in sums:
             self.mse = float(sums["mse_sum"]) / max(bs, 1.0)
+        if "confusion" in sums and \
+                getattr(self.evaluator, "confusion_matrix", None) is not None:
+            # accumulate like the eager evaluator; the Decision copies and
+            # zeroes the matrix at each class-pass end (finalize_class)
+            conf = np.rint(np.asarray(sums["confusion"])).astype(np.int64)
+            if cumulative:
+                delta = conf if self._conf_seen is None else \
+                    conf - self._conf_seen
+                self._conf_seen = conf
+            else:
+                delta = conf
+            self.evaluator.confusion_matrix += delta
 
     def flush_metrics(self) -> None:
         """Sync pending deferred sums into the host mirrors (probe/debug
@@ -870,7 +901,7 @@ class FusedTrainStep(Unit):
         reset — the class pass keeps accumulating, so a mid-pass flush never
         truncates the Decision's epoch accounting."""
         if self._acc is not None:
-            self._publish(jax.device_get(self._acc))
+            self._publish(jax.device_get(self._acc), cumulative=True)
 
     def stop(self) -> None:
         if self._params is not None:
