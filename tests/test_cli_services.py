@@ -312,3 +312,46 @@ def test_cli_ensemble_train_rejects_bad_usage(tmp_path, monkeypatch):
     assert cli_main([str(wf), "--ensemble-train", "0", "-d", "tpu"]) == 2
     assert cli_main([str(wf), "--ensemble-train", "2", "-d", "tpu",
                      "--publish", "markdown"]) == 2
+
+
+def test_forge_cli_roundtrip(tmp_path, capsys):
+    """`znicz_tpu forge upload/list/fetch` — the reference's forge CLI
+    over the local registry."""
+    import numpy as np
+
+    from znicz_tpu.__main__ import main
+
+    pkg = tmp_path / "pkg.npz"
+    np.savez(pkg, w=np.arange(4.0))
+    reg = str(tmp_path / "registry")
+
+    assert main(["forge", "--registry", reg, "upload", str(pkg),
+                 "--name", "demo", "--version", "1.0"]) == 0
+    assert main(["forge", "--registry", reg, "upload", str(pkg),
+                 "--name", "demo", "--version", "1.10"]) == 0
+    assert main(["forge", "--registry", reg, "list"]) == 0
+    out = capsys.readouterr().out
+    assert "demo: 1.0, 1.10" in out          # semantic version order
+
+    dest = tmp_path / "fetched.npz"
+    assert main(["forge", "--registry", reg, "fetch", "demo",
+                 "-o", str(dest)]) == 0      # latest = 1.10
+    assert dest.exists()
+    with np.load(dest) as loaded:
+        np.testing.assert_array_equal(loaded["w"], np.arange(4.0))
+
+
+def test_forge_cli_errors_are_one_liners(tmp_path, capsys):
+    """Registry failures exit 2 with a one-line stderr message, not a
+    traceback (CLI convention)."""
+    from znicz_tpu.__main__ import main
+
+    reg = str(tmp_path / "reg")
+    assert main(["forge", "--registry", reg, "fetch", "nosuch"]) == 2
+    err = capsys.readouterr().err
+    assert "forge:" in err and "nosuch" in err
+
+    assert main(["forge", "--registry", reg, "upload",
+                 str(tmp_path / "missing.npz"),
+                 "--name", "x", "--version", "1"]) == 2
+    assert "forge:" in capsys.readouterr().err

@@ -3,11 +3,14 @@
 
 Usage:
     python -m znicz_tpu <workflow.py> [config.py ...] [options]
+    python -m znicz_tpu forge {list,upload,fetch} ...
 
 The workflow file must expose ``run(load, main)`` (every models/ sample
 does); config files are executed Python mutating the global ``root`` tree;
 ``-o root.path=value`` applies last.  ``--optimize N`` wraps the run in
-the genetic hyperparameter search over ``Tune`` leaves.
+the genetic hyperparameter search over ``Tune`` leaves.  The ``forge``
+subcommand is the reference's ``veles forge fetch/upload`` pair over the
+local package registry (utils/forge.py).
 """
 
 from __future__ import annotations
@@ -88,7 +91,57 @@ def make_device(name: str):
             "numpy": NumpyDevice}[name]()
 
 
+def forge_main(argv) -> int:
+    """``forge list|upload|fetch`` — the reference's model-zoo up/download
+    CLI (veles forge ...) over the local registry."""
+    from znicz_tpu.utils.forge import ForgeRegistry
+
+    p = argparse.ArgumentParser(prog="znicz_tpu forge",
+                                description="model-zoo package registry")
+    p.add_argument("--registry", default=None,
+                   help="registry directory (default: root.common.forge."
+                        "dir or ./.forge)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="list packages and versions")
+    up = sub.add_parser("upload", help="register a forward package")
+    up.add_argument("package", help="path to a utils/export.py .npz")
+    up.add_argument("--name", required=True)
+    up.add_argument("--version", required=True)
+    fe = sub.add_parser("fetch", help="resolve + checksum-verify a package")
+    fe.add_argument("name")
+    fe.add_argument("--version", default=None,
+                    help="semantic latest when omitted")
+    fe.add_argument("-o", "--output", default=None,
+                    help="copy to this path (default: print the "
+                         "in-registry path)")
+    args = p.parse_args(argv)
+    reg = ForgeRegistry(registry_dir=args.registry)
+    try:
+        if args.cmd == "list":
+            for name, versions in sorted(reg.list_packages().items()):
+                print(f"{name}: {', '.join(versions)}")
+            return 0
+        if args.cmd == "upload":
+            entry = reg.upload(args.package, args.name, args.version)
+            print(f"uploaded {args.name}=={args.version} "
+                  f"(sha256 {entry['sha256'][:12]})")
+            return 0
+        path = reg.fetch(args.name, version=args.version, dest=args.output)
+        print(path)
+        return 0
+    except (KeyError, OSError, FileExistsError) as exc:
+        # unknown package/version, missing file, corrupt checksum,
+        # immutable re-upload — one-line error, CLI convention
+        msg = exc.args[0] if exc.args else exc
+        print(f"forge: {msg}", file=sys.stderr)
+        return 2
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "forge":
+        return forge_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.coordinator is not None:
         multihost(args.coordinator, args.num_processes, args.process_id)
