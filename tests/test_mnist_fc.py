@@ -308,3 +308,29 @@ def test_fused_confusion_matrix_survives_midpass_flush():
     w.decision.run()
     mat = w.decision.confusion_matrixes[TRAIN]
     assert mat is not None and mat.sum() == cfg["n_train"], mat
+
+
+def test_evaluator_mse_nearest_target_unit():
+    """Direct nearest-target check: hand-set prototypes, outputs nearer
+    the wrong prototype count as errors; padded rows do not."""
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.evaluator import EvaluatorMSE
+
+    w = Workflow(name="nt")
+    ev = EvaluatorMSE(w)
+    protos = np.array([[0.0, 0.0], [10.0, 10.0]], np.float32)
+    ev.output.mem = np.array([[0.1, 0.2],     # -> proto 0, label 0: ok
+                              [9.0, 9.5],     # -> proto 1, label 0: ERR
+                              [9.9, 9.9],     # padded row: would be an
+                              ], np.float32)  # error if mask broke
+    ev.target.mem = protos[[0, 0, 1]]
+    # padded row's label DISAGREES with its nearest prototype, so a
+    # batch_size-mask regression flips n_err to 2
+    ev.labels.mem = np.array([0, 0, 0], np.int32)
+    ev.class_targets.mem = protos
+    ev.batch_size = 2
+    ev.initialize(device=NumpyDevice())
+    ev.run()
+    assert ev._classifies
+    assert ev.n_err == 1
+    assert ev.rmse > 0.0

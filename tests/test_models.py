@@ -103,3 +103,42 @@ def test_run_load_main_shape():
 
     wine.run(load, main)
     assert bool(built["w"].decision.complete)
+
+
+def test_approximator_sample():
+    """Function-approximation MSE workflow (reference: Approximator
+    sample): validation mse must follow the pinned seeded trajectory."""
+    from znicz_tpu.models import approximator
+
+    prng.seed_all(31)
+    w = approximator.build(max_epochs=5)
+    w.initialize(device=TPUDevice())
+    w.run()
+    np.testing.assert_allclose(
+        [h["metric_validation"] for h in w.decision.metrics_history],
+        [2.572527, 0.283226, 0.18658, 0.079837, 0.054828],
+        rtol=1e-4, err_msg=str(w.decision.metrics_history))
+
+
+def test_approximator_nearest_target_classification():
+    """prototypes=P: EvaluatorMSE reports integer nearest-target n_err
+    (reference: the approximator samples' classification metric) on BOTH
+    eager backends, and training drives it to zero."""
+    import pytest
+
+    from znicz_tpu.core.backends import NumpyDevice
+    from znicz_tpu.models import approximator
+
+    for device_cls in (NumpyDevice, TPUDevice):
+        prng.seed_all(31)
+        w = approximator.build(max_epochs=5, prototypes=5, fused=False)
+        w.initialize(device=device_cls())
+        assert w.evaluator.class_targets.shape == (5, 4)
+        w.run()
+        assert w.evaluator._classifies
+        assert isinstance(w.evaluator.n_err, int)
+        assert w.evaluator.n_err == 0, device_cls  # final batch classified
+
+    # the fused default would silently skip n_err: must refuse
+    with pytest.raises(ValueError, match="fused=False"):
+        approximator.build(prototypes=5)

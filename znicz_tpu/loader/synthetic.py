@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from znicz_tpu.core import prng
+from znicz_tpu.core.memory import Array
 from znicz_tpu.loader.base import TEST, VALID, TRAIN, register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 
@@ -117,11 +118,20 @@ class SyntheticImageLoader(SyntheticClassifierLoader):
 @register_loader("synthetic_regression")
 class SyntheticRegressionLoader(FullBatchLoaderMSE):
     """Seeded regression dataset: targets are a fixed random linear map of
-    the inputs plus noise (autoencoder/MSE workflow test data)."""
+    the inputs plus noise (autoencoder/MSE workflow test data).
+
+    ``prototypes=P`` switches to the approximator-classification shape
+    (reference: the approximator samples' nearest-target evaluation):
+    inputs are per-class Gaussian blobs, targets are the class's exact
+    prototype vector, and ``labels`` + ``class_targets`` feed
+    EvaluatorMSE's nearest-target ``n_err``.
+    """
 
     def __init__(self, workflow=None, sample_shape=(16,), target_shape=(4,),
                  n_train: int = 512, n_valid: int = 128,
-                 identity: bool = False, **kwargs) -> None:
+                 identity: bool = False, prototypes: int = 0,
+                 spread: float = 2.0, noise: float = 1.0,
+                 **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.sample_shape = tuple(sample_shape)
         self.target_shape = tuple(target_shape)
@@ -129,11 +139,32 @@ class SyntheticRegressionLoader(FullBatchLoaderMSE):
         self.n_valid = n_valid
         #: identity=True -> targets = inputs (autoencoder reconstruction)
         self.identity = identity
+        self.prototypes = int(prototypes)
+        self.spread = spread
+        self.noise = noise
+        self.class_targets = Array()   # (P, *target_shape) in proto mode
 
     def load_data(self) -> None:
         gen = prng.get("synthetic")
         n = self.n_valid + self.n_train
         dim = int(np.prod(self.sample_shape))
+        if self.prototypes:
+            P = self.prototypes
+            tdim = int(np.prod(self.target_shape))
+            means = gen.normal(0.0, self.spread, (P, dim)).astype(np.float32)
+            protos = gen.normal(0.0, 1.0, (P, tdim)).astype(np.float32)
+            labels = (np.arange(n) % P).astype(np.int32)
+            gen.shuffle(labels)
+            data = means[labels] + \
+                gen.normal(0.0, self.noise, (n, dim)).astype(np.float32)
+            self.original_data.mem = data.reshape((n,) + self.sample_shape)
+            self.original_targets.mem = protos[labels].reshape(
+                (n,) + self.target_shape)
+            self.original_labels.mem = labels
+            self.class_targets.mem = protos.reshape(
+                (P,) + self.target_shape)
+            self.class_lengths = [0, self.n_valid, self.n_train]
+            return
         data = gen.normal(0.0, 1.0, (n, dim)).astype(np.float32)
         if self.identity:
             targets = data.copy().reshape((n,) + self.sample_shape)

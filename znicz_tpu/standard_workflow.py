@@ -220,6 +220,12 @@ class StandardWorkflow(StandardWorkflowBase):
             ev.link_attrs(parent, "output")
             ev.link_attrs(self.loader, ("target", "minibatch_targets"),
                           ("batch_size", "minibatch_size"))
+            if hasattr(self.loader, "class_targets"):
+                # nearest-target classification (approximator samples):
+                # empty arrays at build time are fine — the evaluator
+                # checks content at run time
+                ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
+                              "class_targets")
         ev.link_from(parent)
 
     def link_decision(self, parent) -> None:

@@ -135,14 +135,19 @@ class EvaluatorMSE(EvaluatorBase):
     """Mean-squared-error evaluator (reference: EvaluatorMSE).
 
     err_output = output - target (masked); metrics: per-sample ``mse``
-    vector over the valid rows, batch ``rmse``; optional ``n_err`` when
-    ``labels``+``class_targets`` given (nearest-target classification, used
-    by the approximator samples).
+    vector over the valid rows, batch ``rmse``.  When ``labels`` AND
+    ``class_targets`` are linked (the approximator samples: class_targets
+    holds one prototype vector per class), ``n_err`` additionally counts
+    nearest-target misclassifications — argmin over ||output - proto_c||
+    vs the integer label; otherwise ``n_err`` mirrors mse (what the MSE
+    Decision tracks).
     """
 
     def __init__(self, workflow=None, root_mse: bool = True, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.target = Array()  # linked from loader (minibatch_targets)
+        self.labels = Array()         # optional: integer class labels
+        self.class_targets = Array()  # optional: (n_classes, *target_shape)
         self.root_mse = root_mse
         self.mse = 0.0
         self.rmse = 0.0
@@ -158,6 +163,30 @@ class EvaluatorMSE(EvaluatorBase):
         mse = sample_mse.sum() / batch_size
         return err, mse
 
+    @staticmethod
+    def _nearest_target_errors(xp, y, protos, labels, batch_size):
+        """Count argmin_c ||y_i - protos[c]||^2 != labels_i over the
+        valid rows (reference: nearest-target classification)."""
+        n = y.shape[0]
+        flat = y.reshape(n, -1)
+        pf = protos.reshape(protos.shape[0], -1)
+        d = ((flat[:, None, :] - pf[None, :, :]) ** 2).sum(axis=2)
+        pred = d.argmin(axis=1)
+        valid = xp.arange(n) < batch_size
+        return ((pred != labels) & valid).sum()
+
+    @property
+    def _classifies(self) -> bool:
+        return bool(self.labels) and bool(self.class_targets)
+
+    def _common_init(self, **kwargs) -> None:
+        super()._common_init(**kwargs)
+        if self._classifies:
+            # the linked label/prototype arrays need device buffers for
+            # the xla_run path (the loader only initializes its own
+            # minibatch arrays)
+            self.init_array(self.labels, self.class_targets)
+
     def numpy_run(self) -> None:
         y = self.output.map_read()
         target = self.target.map_read()
@@ -167,11 +196,19 @@ class EvaluatorMSE(EvaluatorBase):
         self.err_output.mem = err
         self.mse = float(mse)
         self.rmse = float(np.sqrt(self.mse))
-        self.n_err = self.mse  # Decision tracks mse for MSE workflows
+        if self._classifies:
+            self.n_err = int(self._nearest_target_errors(
+                np, y, self.class_targets.map_read(),
+                self.labels.map_read(), bs))
+        else:
+            self.n_err = self.mse  # Decision tracks mse for MSE workflows
 
     def xla_init(self) -> None:
         self._xla_fn = jax.jit(
             lambda y, t, bs: self._compute(jnp, y, t, bs))
+        self._xla_nt_fn = jax.jit(
+            lambda y, p, labels, bs:
+            self._nearest_target_errors(jnp, y, p, labels, bs))
 
     def xla_run(self) -> None:
         for arr in (self.output, self.target):
@@ -181,4 +218,11 @@ class EvaluatorMSE(EvaluatorBase):
         self.err_output.set_devmem(err)
         self.mse = float(mse)
         self.rmse = float(np.sqrt(self.mse))
-        self.n_err = self.mse
+        if self._classifies:
+            self.labels.unmap()
+            self.class_targets.unmap()
+            self.n_err = int(self._xla_nt_fn(
+                self.output.devmem, self.class_targets.devmem,
+                self.labels.devmem, bs))
+        else:
+            self.n_err = self.mse
