@@ -183,3 +183,72 @@ def test_deconv_is_conv_adjoint(case):
     e = rng.normal(size=y.shape)
     back = deconv.forward(np, e, wt, sliding, padding, x.shape)
     np.testing.assert_allclose((y * e).sum(), (x * back).sum(), rtol=1e-9)
+
+
+@st.composite
+def lrn_cases(draw):
+    n = draw(st.integers(1, 2))
+    h = draw(st.integers(1, 4))
+    w = draw(st.integers(1, 4))
+    c = draw(st.integers(1, 12))
+    win = draw(st.integers(1, 7))
+    beta = draw(st.sampled_from([0.5, 0.75, 1.0]))
+    seed = draw(st.integers(0, 2 ** 31 - 1))
+    return n, h, w, c, win, beta, seed
+
+
+@given(lrn_cases())
+@settings(**SETTINGS)
+def test_lrn_backward_matches_central_differences(case):
+    """LRN is nonlinear — fuzz the hand-derived backward against central
+    differences of the forward for random window sizes/betas (incl.
+    window > channels and even windows, where the adjoint padding
+    asymmetry matters)."""
+    from znicz_tpu.ops import lrn
+
+    n, h, w, c, win, beta, seed = case
+    alpha, k = 1e-2, 2.0
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    e = rng.normal(size=x.shape)
+    grad = lrn.backward(np, x, e, alpha, beta, k, win)
+    # directional derivative along a random direction
+    d = rng.normal(size=x.shape)
+    eps = 1e-6
+    fp = (lrn.forward(np, x + eps * d, alpha, beta, k, win) * e).sum()
+    fm = (lrn.forward(np, x - eps * d, alpha, beta, k, win) * e).sum()
+    np.testing.assert_allclose((grad * d).sum(), (fp - fm) / (2 * eps),
+                               rtol=1e-4, atol=1e-7)
+
+
+@given(st.sampled_from([activations.TANH, activations.RELU,
+                        activations.STRICT_RELU, activations.SIGMOID,
+                        activations.LOG, activations.SINCOS,
+                        activations.TANHLOG, activations.LINEAR]),
+       st.integers(0, 2 ** 31 - 1))
+@settings(**SETTINGS)
+def test_activation_backward_matches_central_differences(name, seed):
+    """Every activation's backward against central differences, fuzzed
+    over random inputs (the standalone units' derivative_from_input
+    path)."""
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(3, 16)) * 2.0
+    # keep away from the kink/switch points where the one-sided
+    # derivative is ill-defined (strict relu at 0, tanhlog at |v|=d)
+    if name == activations.STRICT_RELU:
+        v = v + np.sign(v) * 0.05
+    if name == activations.TANHLOG:
+        d = activations.TANHLOG_D
+        v = np.where(abs(abs(v) - d) < 0.05, v + 0.1 * np.sign(v), v)
+    e = rng.normal(size=v.shape)
+    y = activations.forward(np, name, v)
+    # the production path of the standalone units (ActivationBackward):
+    # derivative_from_input covers log/sincos/tanhlog and falls back to
+    # the from-output form for the rest
+    grad = e * activations.derivative_from_input(np, name, v, y.copy())
+    dd = rng.normal(size=v.shape)
+    eps = 1e-6
+    fp = (activations.forward(np, name, v + eps * dd) * e).sum()
+    fm = (activations.forward(np, name, v - eps * dd) * e).sum()
+    np.testing.assert_allclose((grad * dd).sum(), (fp - fm) / (2 * eps),
+                               rtol=2e-4, atol=1e-6, err_msg=name)
