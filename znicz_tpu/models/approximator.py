@@ -8,7 +8,9 @@ Two dataset shapes via the synthetic_regression loader:
   regression, Decision tracks validation mse;
 - ``prototypes=P``: inputs are class blobs and targets the class's
   prototype vector — the reference's nearest-target classification
-  shape, where EvaluatorMSE also reports integer ``n_err`` (eager mode).
+  shape, where EvaluatorMSE (eager) or the fused step's metrics (the
+  label is recovered as the target's nearest prototype) report integer
+  ``n_err``.
 """
 
 from __future__ import annotations
@@ -34,11 +36,6 @@ def build(max_epochs: int = 10, minibatch_size: int = 40,
           prototypes: int = 0, fused: bool = True, mesh=None,
           loader_config: dict | None = None,
           snapshotter_config: dict | None = None) -> StandardWorkflow:
-    if prototypes and fused:
-        # the fused MSE step consumes targets only; the nearest-target
-        # n_err the prototype mode exists for would silently stay 0
-        raise ValueError("prototypes requires fused=False (nearest-target "
-                         "n_err is computed by the eager EvaluatorMSE)")
     cfg = {"sample_shape": (sample_dim,), "target_shape": (target_dim,),
            "n_train": n_train, "n_valid": n_valid,
            "minibatch_size": minibatch_size, "prototypes": prototypes}

@@ -159,6 +159,7 @@ class FusedTrainStep(Unit):
         self._hyper_cache = None  # (signature, device pytree)
         self._acc = None          # device-side metric sums (deferred mode)
         self._conf_seen = None    # confusion sums already folded this pass
+        self._nt_valid = None     # nearest-target recovery proven valid?
         # metrics the Decision links to (mirrors the evaluator's attrs)
         self.n_err = 0
         self.mse = 0.0
@@ -343,6 +344,30 @@ class FusedTrainStep(Unit):
                 x = fwd.xla_apply(pc, x, rng=unit_rng, train=train)
         return x, logits_tail
 
+    def _nt_recovery_valid(self) -> bool:
+        """Fused nearest-target n_err is emitted only when the label-
+        recovery assumption is PROVEN at trace time: every stored target
+        must be the exact prototype row of its label (noisy targets
+        would silently recover wrong labels — the eager evaluator, which
+        has real label plumbing, stays correct for those).  Cached after
+        the first check."""
+        if self._nt_valid is not None:
+            return self._nt_valid
+        self._nt_valid = False
+        ev = self.evaluator
+        loader = self.loader
+        if isinstance(ev, EvaluatorMSE) and ev._classifies and \
+                loader is not None:
+            targets = getattr(loader, "original_targets", None)
+            labels = getattr(loader, "original_labels", None)
+            if targets and labels:
+                protos = ev.class_targets.map_read()
+                lab = np.asarray(labels.mem)
+                self._nt_valid = bool(
+                    np.array_equal(np.asarray(targets.mem),
+                                   protos[lab]))
+        return self._nt_valid
+
     def _loss_and_metrics(self, out, logits_tail, labels, mask):
         """Masked loss-sum + metric sums over the local shard (f32
         regardless of the forward's compute dtype)."""
@@ -382,7 +407,22 @@ class FusedTrainStep(Unit):
                     labels.reshape(n, -1)) * fmask[:, None]
             loss = 0.5 * (diff * diff).sum()
             mse_sum = (diff * diff).mean(axis=1).sum()
-            return loss, {"loss": loss, "mse_sum": mse_sum}
+            metrics = {"loss": loss, "mse_sum": mse_sum}
+            if self._nt_recovery_valid():
+                # nearest-target classification without label plumbing:
+                # the init-time check proved targets are exact prototype
+                # rows, so the integer label is recoverable as the
+                # nearest prototype of the TARGET; n_err then counts
+                # outputs nearest a different prototype (the eager
+                # evaluator's count).  Prototypes are a small static
+                # table baked in at trace time.
+                protos = jnp.asarray(
+                    self.evaluator.class_targets.map_read(), out.dtype)
+                pred = EvaluatorMSE.nearest_prototype(jnp, out, protos)
+                lab = EvaluatorMSE.nearest_prototype(
+                    jnp, labels.reshape(n, -1).astype(out.dtype), protos)
+                metrics["n_err"] = ((pred != lab) & mask).sum()
+            return loss, metrics
         raise TypeError(f"unsupported evaluator {type(self.evaluator)}")
 
     # -- compiled step bodies ------------------------------------------------

@@ -164,15 +164,20 @@ class EvaluatorMSE(EvaluatorBase):
         return err, mse
 
     @staticmethod
-    def _nearest_target_errors(xp, y, protos, labels, batch_size):
-        """Count argmin_c ||y_i - protos[c]||^2 != labels_i over the
-        valid rows (reference: nearest-target classification)."""
-        n = y.shape[0]
-        flat = y.reshape(n, -1)
+    def nearest_prototype(xp, y, protos):
+        """argmin_c ||y_i - protos[c]||^2 per row — the single distance/
+        argmin definition shared by the eager paths and the fused step."""
+        flat = y.reshape(y.shape[0], -1)
         pf = protos.reshape(protos.shape[0], -1)
         d = ((flat[:, None, :] - pf[None, :, :]) ** 2).sum(axis=2)
-        pred = d.argmin(axis=1)
-        valid = xp.arange(n) < batch_size
+        return d.argmin(axis=1)
+
+    @staticmethod
+    def _nearest_target_errors(xp, y, protos, labels, batch_size):
+        """Count nearest-prototype mispredictions over the valid rows
+        (reference: nearest-target classification)."""
+        pred = EvaluatorMSE.nearest_prototype(xp, y, protos)
+        valid = xp.arange(y.shape[0]) < batch_size
         return ((pred != labels) & valid).sum()
 
     @property
