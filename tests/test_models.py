@@ -173,3 +173,33 @@ def test_fused_nearest_target_skipped_for_noisy_targets():
     w2 = approximator.build(max_epochs=1, prototypes=5)
     w2.initialize(device=TPUDevice())
     assert w2.step._nt_recovery_valid()   # pristine loader: proven exact
+
+
+def test_tv_channels_sample():
+    """TvChannels sample: corner-logo identification with the Cutter
+    cropping the logo region before the conv stack (the unit's first
+    model-zoo consumer).  Pinned seeded trajectory."""
+    from znicz_tpu.models import tv_channels
+
+    prng.seed_all(31)
+    w = tv_channels.build(max_epochs=6)
+    w.initialize(device=TPUDevice())
+    w.run()
+    assert _validation(w.decision.metrics_history) == \
+        [176, 178, 82, 37, 0, 0], w.decision.metrics_history
+    assert w.forwards[0].output.shape == (50, 10, 10, 3)   # cropped
+
+
+def test_tv_channels_eager_gd_cutter():
+    """The eager chain routes gradients through GDCutter (zero-padding
+    the cropped err back into frame geometry) and still converges."""
+    from znicz_tpu.core.backends import NumpyDevice
+    from znicz_tpu.models import tv_channels
+
+    prng.seed_all(31)
+    w = tv_channels.build(max_epochs=8, n_train=400, n_valid=100,
+                          lr=0.05, fused=False)
+    w.initialize(device=NumpyDevice())
+    w.run()
+    val = _validation(w.decision.metrics_history)
+    assert val == [84, 88, 78, 10, 25, 16, 2, 0], val
