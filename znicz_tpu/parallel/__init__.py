@@ -15,7 +15,9 @@ Host-side units (Loader / Decision / Snapshotter / plotters) stay exactly
 where the reference put them — outside the compiled step.
 """
 
-from znicz_tpu.parallel.mesh import make_mesh, data_parallel_mesh
+from znicz_tpu.parallel.mesh import (make_mesh, make_hybrid_mesh,
+                                     data_parallel_mesh)
 from znicz_tpu.parallel.step import FusedTrainStep
 
-__all__ = ["make_mesh", "data_parallel_mesh", "FusedTrainStep"]
+__all__ = ["make_mesh", "make_hybrid_mesh", "data_parallel_mesh",
+           "FusedTrainStep"]

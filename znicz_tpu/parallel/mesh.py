@@ -51,3 +51,61 @@ def data_parallel_mesh(n: Optional[int] = None,
     devs = list(devices) if devices is not None else jax.devices()
     n = n if n is not None else len(devs)
     return make_mesh({"data": n}, devs)
+
+
+def make_hybrid_mesh(axis_sizes: dict[str, int],
+                     dcn_axis_sizes: Optional[dict[str, int]] = None,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """DCN-aware mesh for multi-slice pods (SURVEY.md §6.8: "DCN-aware
+    mesh axes for multi-slice").
+
+    ``axis_sizes`` is the TOTAL per-axis size; ``dcn_axis_sizes`` says how
+    much of each axis spans slices over the data-center network (default
+    1 per axis = everything intra-slice).  Bandwidth rule: only axes whose
+    collectives are one gradient psum per step (``data``, or ``pipe``'s
+    point-to-point transfers) should span DCN; keep ``model``/``seq``
+    (per-layer all-gathers) on ICI.
+
+    On a runtime that reports slice topology (``device.slice_index``,
+    real multi-slice pods) the assignment delegates to
+    ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` so
+    inner-axis neighbors are ICI neighbors; single-slice/CPU platforms
+    degrade to the plain ordered mesh (same axis names and sizes, so the
+    sharded program is identical — only the physical routing differs).
+    """
+    dcn = {k: 1 for k in axis_sizes}
+    dcn.update(dcn_axis_sizes or {})
+    unknown = set(dcn) - set(axis_sizes)
+    if unknown:
+        raise ValueError(f"dcn axes {sorted(unknown)} not in axis_sizes")
+    for name, total in axis_sizes.items():
+        if total % dcn[name]:
+            raise ValueError(f"axis {name!r}: dcn size {dcn[name]} must "
+                             f"divide total {total}")
+    devs = list(devices) if devices is not None else jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    n_dcn = int(np.prod(list(dcn.values())))
+    if n_slices > 1:
+        if n_dcn != n_slices:
+            raise ValueError(f"dcn axes span {n_dcn} slices, runtime "
+                             f"reports {n_slices}")
+        from jax.experimental import mesh_utils
+        ici_shape = tuple(axis_sizes[k] // dcn[k] for k in axis_sizes)
+        ici_n = int(np.prod(ici_shape))
+        # match the single-slice fallback's surplus tolerance: use the
+        # first ici_n devices OF EACH SLICE (create_hybrid_device_mesh
+        # itself demands an exact per-granule count)
+        by_slice: dict[int, list] = {}
+        for d in devs:
+            by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        trimmed = []
+        for sid in sorted(by_slice):
+            if len(by_slice[sid]) < ici_n:
+                raise ValueError(
+                    f"slice {sid} has {len(by_slice[sid])} devices, mesh "
+                    f"wants {ici_n} per slice")
+            trimmed += by_slice[sid][:ici_n]
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, tuple(dcn[k] for k in axis_sizes), devices=trimmed)
+        return Mesh(arr, tuple(axis_sizes.keys()))
+    return make_mesh(axis_sizes, devs)
