@@ -353,3 +353,42 @@ def test_hybrid_mesh_single_slice_fallback(cpu_devices):
         make_hybrid_mesh({"data": 3}, {"data": 2})
     with pytest.raises(ValueError, match="not in axis_sizes"):
         make_hybrid_mesh({"data": 8}, {"pipe": 2})
+
+
+def test_hybrid_mesh_multi_slice_assignment(cpu_devices):
+    """Simulated multi-slice runtime (fake slice_index wrappers): the
+    dcn axis spans slices outermost, surplus slices/devices are trimmed
+    like the single-slice path, and dcn=1 stays inside one slice."""
+    import pytest
+
+    from znicz_tpu.parallel.mesh import make_hybrid_mesh
+
+    class Dev:
+        def __init__(self, d, sid):
+            self._d = d
+            self.slice_index = sid
+
+        def __getattr__(self, name):
+            return getattr(self._d, name)
+
+        def __repr__(self):
+            return f"<s{self.slice_index}:{self._d.id}>"
+
+    devs = [Dev(d, i // 4) for i, d in enumerate(cpu_devices)]  # 2 slices
+
+    mesh = make_hybrid_mesh({"data": 2, "model": 2}, {"data": 2},
+                            devices=devs)
+    assert mesh.devices.shape == (2, 2)
+    # data (the dcn axis) is outermost: row 0 from slice 0, row 1 from 1
+    rows = [[d.slice_index for d in row] for row in mesh.devices]
+    assert rows == [[0, 0], [1, 1]], rows
+
+    # dcn=1 on a multi-slice runtime: stays within one slice
+    mesh1 = make_hybrid_mesh({"data": 4}, devices=devs)
+    assert {d.slice_index for d in mesh1.devices.ravel()} == {0}
+    # ...and refuses when no slice is big enough
+    with pytest.raises(ValueError, match="no single slice"):
+        make_hybrid_mesh({"data": 8}, devices=devs)
+    # more dcn than slices: clear error
+    with pytest.raises(ValueError, match="only"):
+        make_hybrid_mesh({"data": 4}, {"data": 4}, devices=devs)

@@ -85,21 +85,21 @@ def make_hybrid_mesh(axis_sizes: dict[str, int],
     devs = list(devices) if devices is not None else jax.devices()
     n_slices = len({getattr(d, "slice_index", 0) for d in devs})
     n_dcn = int(np.prod(list(dcn.values())))
-    if n_slices > 1:
-        if n_dcn != n_slices:
+    if n_slices > 1 and n_dcn > 1:
+        if n_dcn > n_slices:
             raise ValueError(f"dcn axes span {n_dcn} slices, runtime "
-                             f"reports {n_slices}")
+                             f"reports only {n_slices}")
         from jax.experimental import mesh_utils
         ici_shape = tuple(axis_sizes[k] // dcn[k] for k in axis_sizes)
         ici_n = int(np.prod(ici_shape))
-        # match the single-slice fallback's surplus tolerance: use the
-        # first ici_n devices OF EACH SLICE (create_hybrid_device_mesh
-        # itself demands an exact per-granule count)
+        # surplus tolerance mirroring the single-slice make_mesh path:
+        # the first n_dcn slices, and the first ici_n devices OF EACH
+        # (create_hybrid_device_mesh demands exact per-granule counts)
         by_slice: dict[int, list] = {}
         for d in devs:
             by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
         trimmed = []
-        for sid in sorted(by_slice):
+        for sid in sorted(by_slice)[:n_dcn]:
             if len(by_slice[sid]) < ici_n:
                 raise ValueError(
                     f"slice {sid} has {len(by_slice[sid])} devices, mesh "
@@ -108,4 +108,19 @@ def make_hybrid_mesh(axis_sizes: dict[str, int],
         arr = mesh_utils.create_hybrid_device_mesh(
             ici_shape, tuple(dcn[k] for k in axis_sizes), devices=trimmed)
         return Mesh(arr, tuple(axis_sizes.keys()))
+    if n_slices > 1:
+        # n_dcn == 1 means "everything intra-slice": honor it by building
+        # from one slice when it holds enough devices (devs[:n] could
+        # otherwise silently straddle the DCN boundary)
+        total = int(np.prod(list(axis_sizes.values())))
+        by_slice = {}
+        for d in devs:
+            by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        for sid in sorted(by_slice):
+            if len(by_slice[sid]) >= total:
+                return make_mesh(axis_sizes, by_slice[sid])
+        raise ValueError(
+            f"no single slice holds the {total} devices this mesh wants "
+            f"(largest has {max(len(v) for v in by_slice.values())}); "
+            f"give the slice-spanning axis a dcn_axis_sizes entry")
     return make_mesh(axis_sizes, devs)
