@@ -10,7 +10,6 @@ that the fixed model-zoo stacks never permute.
 """
 
 import numpy as np
-import pytest
 from hypothesis import given, settings, strategies as st
 
 from znicz_tpu.core import prng
@@ -59,15 +58,19 @@ def layer_stacks(draw):
     return stack, seed
 
 
-def _one_step(stack, seed, fused, device):
+def _build(stack, seed, fused=True):
     prng.seed_all(seed)
-    w = StandardWorkflow(
+    return StandardWorkflow(
         name="fuzz", layers=[dict(d) for d in stack],
         loss_function="softmax", loader_name="synthetic_image",
         loader_config={"n_classes": 3, "sample_shape": (8, 8, 2),
                        "n_train": 24, "n_valid": 0, "minibatch_size": 12,
                        "spread": 2.0},
         decision_config={"max_epochs": 1}, fused=fused)
+
+
+def _one_step(stack, seed, fused, device):
+    w = _build(stack, seed, fused)
     w.initialize(device=device)
     w.loader.run()
     if fused:
@@ -87,16 +90,39 @@ def _one_step(stack, seed, fused, device):
 def test_fused_matches_eager_for_random_stacks(case):
     stack, seed = case
     has_dropout = any(d["type"] == "dropout" for d in stack)
+    if has_dropout:
+        # dropout masks come from different PRNG systems in the two
+        # execution shapes (host xorshift vs counter-based) — exact
+        # update parity does not apply; instead assert BOTH shapes
+        # actually trained: finite params that moved from their init,
+        # captured AFTER initialize and BEFORE the train step
+        for fused, device in ((True, TPUDevice()), (False, NumpyDevice())):
+            w = _build(stack, seed, fused)
+            w.initialize(device=device)
+            init = [f.weights.map_read().copy() for f in w.forwards
+                    if f.weights]
+            w.loader.run()
+            if fused:
+                w.step.run()
+                w.step.sync_to_units()
+            else:
+                for f in w.forwards:
+                    f.run()
+                w.evaluator.run()
+                for gd in reversed(w.gds):
+                    gd.run()
+            trained = [f.weights.map_read() for f in w.forwards
+                       if f.weights]
+            assert any(not np.array_equal(a, b)
+                       for a, b in zip(init, trained)), fused
+            for t in trained:
+                assert np.isfinite(t).all(), fused
+        return
     we = _one_step(stack, seed, False, NumpyDevice())
     wf = _one_step(stack, seed, True, TPUDevice())
     checked = 0
     for i, (fe, ff) in enumerate(zip(we.forwards, wf.forwards)):
         if not fe.weights:
-            continue
-        if has_dropout:
-            # dropout masks come from different PRNG systems (host
-            # xorshift vs counter-based) — updates legitimately differ;
-            # assert both CHANGED the weights instead
             continue
         np.testing.assert_allclose(
             ff.weights.map_read(), fe.weights.map_read(),
@@ -107,14 +133,7 @@ def test_fused_matches_eager_for_random_stacks(case):
             rtol=2e-4, atol=2e-5,
             err_msg=f"layer {i} ({stack[i]['type']}) bias")
         checked += 1
-    if has_dropout:
-        # weaker invariant for stochastic stacks: the fused step ran and
-        # produced finite params
-        for ff in wf.forwards:
-            if ff.weights:
-                assert np.isfinite(ff.weights.map_read()).all()
-    else:
-        assert checked >= 1
+    assert checked >= 1
 
 
 @given(layer_stacks())
@@ -143,4 +162,7 @@ def test_random_stacks_snapshot_roundtrip(case):
         if fa.weights:
             np.testing.assert_array_equal(
                 fb.weights.map_read(), fa.weights.map_read(),
-                err_msg=f"layer {i} ({stack[i]['type']})")
+                err_msg=f"layer {i} ({stack[i]['type']}) weights")
+            np.testing.assert_array_equal(
+                fb.bias.map_read(), fa.bias.map_read(),
+                err_msg=f"layer {i} ({stack[i]['type']}) bias")

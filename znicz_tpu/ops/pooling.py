@@ -128,9 +128,13 @@ def avg_forward_fast(x, ky, kx, sy, sx):
     """Fused-path avg pooling: windowed sum via ``reduce_window`` divided
     by the static clipped-window element count (border semantics kept)."""
     pb, pr = _border_pad(x.shape[1], x.shape[2], ky, kx, sy, sx)
+    # init must be a CONCRETE scalar: a traced jnp.zeros(()) init makes
+    # reduce_window's linearization fail under shard_map ("Linearization
+    # failed to produce known values for all output primals") — found by
+    # the composition fuzzer, tests/test_workflow_fuzz.py
     s = lax.reduce_window(
-        x, jnp.zeros((), x.dtype), lax.add, (1, ky, kx, 1), (1, sy, sx, 1),
-        ((0, 0), (0, pb), (0, pr), (0, 0)))
+        x, np.zeros((), x.dtype)[()], lax.add, (1, ky, kx, 1),
+        (1, sy, sx, 1), ((0, 0), (0, pb), (0, pr), (0, 0)))
     _, count = window_counts(x.shape[1], x.shape[2], ky, kx, sy, sx)
     return s / jnp.asarray(count[None], x.dtype)
 
