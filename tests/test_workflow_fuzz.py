@@ -69,9 +69,8 @@ def _build(stack, seed, fused=True):
         decision_config={"max_epochs": 1}, fused=fused)
 
 
-def _one_step(stack, seed, fused, device):
-    w = _build(stack, seed, fused)
-    w.initialize(device=device)
+def _run_one_minibatch(w, fused):
+    """The one-train-minibatch protocol shared by every fuzz test."""
     w.loader.run()
     if fused:
         w.step.run()
@@ -83,6 +82,12 @@ def _one_step(stack, seed, fused, device):
         for gd in reversed(w.gds):
             gd.run()
     return w
+
+
+def _one_step(stack, seed, fused, device):
+    w = _build(stack, seed, fused)
+    w.initialize(device=device)
+    return _run_one_minibatch(w, fused)
 
 
 @given(layer_stacks())
@@ -101,16 +106,7 @@ def test_fused_matches_eager_for_random_stacks(case):
             w.initialize(device=device)
             init = [f.weights.map_read().copy() for f in w.forwards
                     if f.weights]
-            w.loader.run()
-            if fused:
-                w.step.run()
-                w.step.sync_to_units()
-            else:
-                for f in w.forwards:
-                    f.run()
-                w.evaluator.run()
-                for gd in reversed(w.gds):
-                    gd.run()
+            _run_one_minibatch(w, fused)
             trained = [f.weights.map_read() for f in w.forwards
                        if f.weights]
             assert any(not np.array_equal(a, b)
@@ -165,4 +161,64 @@ def test_random_stacks_snapshot_roundtrip(case):
                 err_msg=f"layer {i} ({stack[i]['type']}) weights")
             np.testing.assert_array_equal(
                 fb.bias.map_read(), fa.bias.map_read(),
+                err_msg=f"layer {i} ({stack[i]['type']}) bias")
+
+
+@st.composite
+def ae_stacks(draw):
+    """Random conv->deconv reconstruction geometry (kernel size, stride,
+    kernel count, channels) — the deconv must exactly invert the conv's
+    spatial map for the MSE-vs-input loss to typecheck."""
+    k = draw(st.integers(2, 4))
+    stride = draw(st.integers(1, 2))
+    nk = draw(st.sampled_from([4, 8]))
+    c = draw(st.integers(1, 2))
+    # invertible geometry: (H - k) % stride == 0, else the conv drops
+    # tail rows and the reconstruction cannot match the input shape
+    H = k + stride * draw(st.integers(2, 4))
+    lr = 0.002
+    stack = [
+        {"type": "conv", "->": {"n_kernels": nk, "kx": k, "ky": k,
+                                "sliding": (stride, stride)},
+         "<-": {"learning_rate": lr, "gradient_moment": 0.5}},
+        {"type": "deconv", "->": {"n_kernels": nk, "kx": k, "ky": k,
+                                  "sliding": (stride, stride),
+                                  "n_channels": c},
+         "<-": {"learning_rate": lr, "gradient_moment": 0.5}},
+    ]
+    seed = draw(st.integers(1, 2 ** 20))
+    return stack, H, c, seed
+
+
+@given(ae_stacks())
+@settings(max_examples=6, deadline=None, derandomize=True)
+def test_ae_fused_matches_eager_for_random_geometry(case):
+    """Conv->deconv autoencoder: fused AD backward equals the
+    hand-written GDDeconv/GDConv chain for random geometry (the adjoint
+    pair composed end-to-end through the MSE evaluator)."""
+    stack, H, c, seed = case
+
+    def one_step(fused, device):
+        prng.seed_all(seed)
+        w = StandardWorkflow(
+            name="aefuzz", layers=[dict(d) for d in stack],
+            loss_function="mse", loader_name="synthetic_regression",
+            loader_config={"sample_shape": (H, H, c), "identity": True,
+                           "n_train": 24, "n_valid": 0,
+                           "minibatch_size": 12},
+            decision_config={"max_epochs": 1}, fused=fused)
+        w.initialize(device=device)
+        return _run_one_minibatch(w, fused)
+
+    we = one_step(False, NumpyDevice())
+    wf = one_step(True, TPUDevice())
+    for i, (fe, ff) in enumerate(zip(we.forwards, wf.forwards)):
+        np.testing.assert_allclose(
+            ff.weights.map_read(), fe.weights.map_read(),
+            rtol=3e-4, atol=3e-5,
+            err_msg=f"layer {i} ({stack[i]['type']}) weights")
+        if fe.bias:          # deconv carries no bias; conv does
+            np.testing.assert_allclose(
+                ff.bias.map_read(), fe.bias.map_read(),
+                rtol=3e-4, atol=3e-5,
                 err_msg=f"layer {i} ({stack[i]['type']}) bias")
