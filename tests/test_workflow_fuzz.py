@@ -35,8 +35,9 @@ def layer_stacks(draw):
                              "kx": 3, "ky": 3, "padding": (1, 1, 1, 1)},
                       "<-": dict(HYPER)})
         extra = draw(st.sampled_from(
-            ["none", "max_pooling", "avg_pooling", "norm", "dropout"]))
-        if extra == "max_pooling" or extra == "avg_pooling":
+            ["none", "max_pooling", "avg_pooling", "stochastic_pooling",
+             "norm", "dropout"]))
+        if extra in ("max_pooling", "avg_pooling", "stochastic_pooling"):
             stack.append({"type": extra, "->": {"kx": 2, "ky": 2}})
         elif extra == "norm":
             stack.append({"type": "norm",
@@ -94,13 +95,14 @@ def _one_step(stack, seed, fused, device):
 @settings(**SETTINGS)
 def test_fused_matches_eager_for_random_stacks(case):
     stack, seed = case
-    has_dropout = any(d["type"] == "dropout" for d in stack)
-    if has_dropout:
-        # dropout masks come from different PRNG systems in the two
-        # execution shapes (host xorshift vs counter-based) — exact
-        # update parity does not apply; instead assert BOTH shapes
-        # actually trained: finite params that moved from their init,
-        # captured AFTER initialize and BEFORE the train step
+    stochastic = any(d["type"] in ("dropout", "stochastic_pooling")
+                     for d in stack)
+    if stochastic:
+        # dropout masks / stochastic-pool draws come from different PRNG
+        # systems in the two execution shapes (host xorshift vs
+        # counter-based) — exact update parity does not apply; instead
+        # assert BOTH shapes actually trained: finite params that moved
+        # from their init, captured AFTER initialize, BEFORE the step
         for fused, device in ((True, TPUDevice()), (False, NumpyDevice())):
             w = _build(stack, seed, fused)
             w.initialize(device=device)
