@@ -251,7 +251,6 @@ def test_publisher_markdown_and_html(tmp_path):
 def test_cli_publish_flag(tmp_path, monkeypatch):
     import textwrap
     from znicz_tpu.__main__ import main as cli_main
-    from znicz_tpu.core.config import root
 
     wf = tmp_path / "wf.py"
     wf.write_text(textwrap.dedent("""

@@ -6,7 +6,6 @@ import os
 import textwrap
 
 import numpy as np
-import pytest
 
 from znicz_tpu.__main__ import main as cli_main
 from znicz_tpu.core import prng

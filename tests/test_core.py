@@ -4,7 +4,6 @@ memory mapping, prng determinism — the rebuild of veles/tests/ core tests."""
 import pickle
 
 import numpy as np
-import pytest
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.backends import NumpyDevice, TPUDevice

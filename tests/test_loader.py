@@ -6,7 +6,7 @@ import numpy as np
 from znicz_tpu.core import prng
 from znicz_tpu.core.backends import NumpyDevice
 from znicz_tpu.core.workflow import Workflow
-from znicz_tpu.loader.base import TEST, VALID, TRAIN
+from znicz_tpu.loader.base import VALID, TRAIN
 from znicz_tpu.loader.synthetic import (SyntheticClassifierLoader,
                                         SyntheticRegressionLoader)
 

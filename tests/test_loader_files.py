@@ -13,7 +13,7 @@ from znicz_tpu.core import prng
 from znicz_tpu.core.backends import TPUDevice
 from znicz_tpu.core.workflow import Workflow
 from znicz_tpu.loader import mnist as mnist_mod
-from znicz_tpu.loader.base import TEST, VALID, TRAIN
+from znicz_tpu.loader.base import VALID, TRAIN
 from znicz_tpu.loader.image import (FileImageLoader, FullBatchImageLoader,
                                     synthesize_image_dataset)
 from znicz_tpu.loader.normalization import (NORMALIZER_REGISTRY,

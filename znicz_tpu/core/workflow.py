@@ -22,7 +22,6 @@ import time
 from collections import deque
 from typing import Optional
 
-from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.plumbing import EndPoint, StartPoint
 from znicz_tpu.core.units import Unit
 

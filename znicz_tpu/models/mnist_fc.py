@@ -14,7 +14,6 @@ network egress); a real-MNIST loader slots in via the ``loader`` argument.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.plumbing import Repeater

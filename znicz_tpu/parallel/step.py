@@ -43,7 +43,6 @@ Loader/Decision/Snapshotter stay host-side exactly like the reference.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import numpy as np

@@ -12,7 +12,6 @@ Megatron column/row pattern expressed for shard_map).
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 from jax import lax
 
 

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
 
 import uuid
 

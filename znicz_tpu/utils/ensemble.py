@@ -11,7 +11,7 @@ multi-host upgrade path, SURVEY.md §3.4 hyperparameter-parallelism row).
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable
 
 import numpy as np
 
