@@ -224,3 +224,21 @@ def test_ae_fused_matches_eager_for_random_geometry(case):
                 ff.bias.map_read(), fe.bias.map_read(),
                 rtol=3e-4, atol=3e-5,
                 err_msg=f"layer {i} ({stack[i]['type']}) bias")
+
+    # snapshot roundtrip holds for the MSE/deconv composition too
+    import os
+    import tempfile
+
+    from znicz_tpu.snapshotter import (collect_state, restore_state,
+                                       write_snapshot)
+
+    arrays, meta = collect_state(wf)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "s.npz")
+        write_snapshot(path, arrays, meta)
+        w2 = one_step(True, TPUDevice())
+        restore_state(w2, path)
+        w2.step.sync_to_units()
+    for fa, fb in zip(wf.forwards, w2.forwards):
+        np.testing.assert_array_equal(fb.weights.map_read(),
+                                      fa.weights.map_read())
