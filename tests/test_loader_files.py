@@ -268,3 +268,105 @@ def test_alexnet_file_image_epoch(tmp_path):
     assert len(hist) == 1
     assert w.loader.normalizer.fitted
     assert hist[0]["metric_validation"] <= 12.0   # 4 classes x 3 valid
+
+
+def test_augmentation_mirror_and_crop(png_tree):
+    """Reference ImageLoader's mirror/crop options: random on TRAIN
+    (seeded, reproducible), deterministic center-crop + no mirror on
+    VALID; served shape follows the crop."""
+    d = png_tree
+
+    def serve(seed, mb_class):
+        prng.seed_all(seed)
+        loader = FileImageLoader(
+            Workflow(name=f"aug{seed}{mb_class}"), data_dir=d,
+            sample_shape=(12, 10, 3), valid_fraction=0.25,
+            minibatch_size=8, mirror=True, crop=(8, 8))
+        loader.initialize(device=TPUDevice())
+        # serve until we reach the requested class
+        for _ in range(100):
+            loader.run()
+            if int(loader.minibatch_class) == mb_class:
+                return loader.minibatch_data.mem.copy(), loader
+        raise AssertionError("class never served")
+
+    assert FileImageLoader(Workflow(name="p"), data_dir=d,
+                           crop=(8, 8)).augmenting
+
+    a1, loader = serve(7, TRAIN)
+    a2, _ = serve(7, TRAIN)
+    np.testing.assert_array_equal(a1, a2)          # seeded: reproducible
+    assert a1.shape[1:] == (8, 8, 3)               # served crop shape
+    b1, _ = serve(8, TRAIN)
+    assert not np.array_equal(a1, b1)              # different stream
+
+    # VALID: center crop, no mirror — the served rows must equal the
+    # plain decode -> center-crop -> normalize of the same files
+    v1, vloader = serve(7, VALID)
+    idx = vloader.minibatch_indices.mem[:vloader.minibatch_size]
+    from znicz_tpu.loader.image import _decode
+    expected = np.stack([_decode(vloader._paths[i], (12, 10, 3))
+                         for i in idx])
+    expected = expected[:, 2:10, 1:9]              # center (12-8)//2=2, (10-8)//2=1
+    expected = vloader.normalizer.normalize(expected)
+    np.testing.assert_allclose(v1[:len(idx)], expected, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="exceeds"):
+        FileImageLoader(Workflow(name="bad"), data_dir=d,
+                        sample_shape=(12, 10, 3), crop=(16, 8))
+
+
+def test_augmenting_full_batch_loader_trains_unpinned(png_tree):
+    """full_batch_image + augmentation: the fused step must NOT pin the
+    dataset (per-serve crops would be skipped), and the workflow still
+    trains end to end."""
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    d = png_tree
+    prng.seed_all(11)
+    w = StandardWorkflow(
+        name="AugTrain",
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 32},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}}],
+        loss_function="softmax", loader_name="full_batch_image",
+        loader_config={"data_dir": d, "sample_shape": (12, 10, 3),
+                       "valid_fraction": 0.25, "minibatch_size": 10,
+                       "mirror": True, "crop": (10, 8)},
+        decision_config={"max_epochs": 6}, fused=True)
+    w.initialize(device=TPUDevice())
+    assert w.loader.augmenting
+    assert w.step._dataset_dev is None              # pinning skipped
+    w.run()
+    hist = [int(h["metric_validation"]) for h in w.decision.metrics_history]
+    assert hist[-1] < hist[0], hist                 # still learns
+
+
+def test_ensemble_over_augmenting_loader(png_tree):
+    """Ensemble evaluation must consume the SERVED view of an augmenting
+    loader (center-crop + normalize), not the raw stored dataset."""
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils.ensemble import Ensemble
+
+    d = png_tree
+
+    def build():
+        return StandardWorkflow(
+            name="AugEns",
+            layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.05,
+                            "gradient_moment": 0.9}}],
+            loss_function="softmax", loader_name="full_batch_image",
+            loader_config={"data_dir": d, "sample_shape": (12, 10, 3),
+                           "valid_fraction": 0.25, "minibatch_size": 10,
+                           "mirror": True, "crop": (10, 8)},
+            decision_config={"max_epochs": 3}, fused=True)
+
+    ens = Ensemble(build, n_members=2, base_seed=50).train(TPUDevice())
+    result = ens.test_classification()
+    # shapes lined up (served geometry) and the committee scored
+    assert result["n"] == ens.members[0].loader.class_lengths[1]
+    assert 0 <= result["committee_err"] <= result["n"]
+    assert len(result["member_errs"]) == 2

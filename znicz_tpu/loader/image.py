@@ -135,13 +135,23 @@ class FileImageLoader(NormalizerStateMixin, Loader):
     ``valid_fraction`` of each class (deterministic seeded split) serves as
     the VALID class; set ``test_fraction`` for a TEST split too.  The
     normalizer is fitted once on up to ``fit_samples`` train images.
+
+    Augmentation (reference: ImageLoader's mirror/crop options):
+    ``mirror=True`` flips each TRAIN sample horizontally with p=0.5
+    (seeded via the framework PRNG — runs are reproducible);
+    ``crop=(ch, cw)`` serves a window of the decoded image — random
+    position on TRAIN, center on VALID/TEST — so the served sample shape
+    becomes ``(ch, cw, c)``.  Augmenting loaders are excluded from the
+    fused step's HBM dataset pinning (the per-minibatch serve is
+    data-dependent).
     """
 
     def __init__(self, workflow=None, data_dir: str = "",
                  sample_shape=(32, 32, 3), valid_fraction: float = 0.15,
                  test_fraction: float = 0.0,
                  normalization_type: str = "mean_disp",
-                 fit_samples: int = 256, **kwargs) -> None:
+                 fit_samples: int = 256, mirror: bool = False,
+                 crop: tuple | None = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.data_dir = data_dir
         self.sample_shape = tuple(sample_shape)
@@ -149,9 +159,53 @@ class FileImageLoader(NormalizerStateMixin, Loader):
         self.test_fraction = test_fraction
         self.normalizer = normalizer_factory(normalization_type)
         self.fit_samples = fit_samples
+        self.mirror = bool(mirror)
+        self.crop = None if crop is None else tuple(crop)
+        if self.crop is not None and (
+                self.crop[0] > self.sample_shape[0] or
+                self.crop[1] > self.sample_shape[1]):
+            raise ValueError(f"crop {self.crop} exceeds decoded sample "
+                             f"{self.sample_shape[:2]}")
         self.class_names: list[str] = []
         self._paths: list[str] = []     # [test | valid | train] order
         self._labels: np.ndarray | None = None
+
+    @property
+    def augmenting(self) -> bool:
+        """True when per-minibatch serves are data-dependent (the fused
+        step must not bypass fill_minibatch with a pinned dataset)."""
+        return self.mirror or self.crop is not None
+
+    @property
+    def served_shape(self) -> tuple:
+        """Shape of one SERVED sample (crop applied)."""
+        if self.crop is None:
+            return self.sample_shape
+        return (self.crop[0], self.crop[1], self.sample_shape[2])
+
+    def _augment(self, batch: np.ndarray, train: bool) -> np.ndarray:
+        """Mirror/crop a decoded (n, H, W, C) batch -> (n, ch, cw, C).
+        Seeded stream: same seed => same augmentation sequence."""
+        if not self.augmenting:
+            return batch
+        gen = prng.get("loader_augment")
+        n, h, w, _c = batch.shape
+        if self.crop is not None:
+            ch, cw = self.crop
+            out = np.empty((n, ch, cw, batch.shape[3]), batch.dtype)
+            if train:
+                oys = gen.randint(0, h - ch + 1, n)
+                oxs = gen.randint(0, w - cw + 1, n)
+            else:
+                oys = np.full(n, (h - ch) // 2)
+                oxs = np.full(n, (w - cw) // 2)
+            for i in range(n):
+                out[i] = batch[i, oys[i]:oys[i] + ch, oxs[i]:oxs[i] + cw]
+            batch = out
+        if self.mirror and train:
+            flips = gen.uniform(0.0, 1.0, n) < 0.5
+            batch[flips] = batch[flips, :, ::-1]
+        return batch
 
     @property
     def n_classes(self) -> int:
@@ -182,16 +236,18 @@ class FileImageLoader(NormalizerStateMixin, Loader):
         if not self.normalizer.fitted:
             train0 = self.class_offset(TRAIN)
             k = min(self.fit_samples, self.class_lengths[TRAIN])
-            # evenly spaced over the (shuffled) train list
+            # evenly spaced over the (shuffled) train list; fitted on the
+            # SERVED geometry (center crop) — mean_disp stats are
+            # per-feature, so crop-then-normalize keeps them aligned
             pick = train0 + np.linspace(
                 0, self.class_lengths[TRAIN] - 1, k).astype(int)
             sample = np.stack([
                 _decode(self._paths[i], self.sample_shape) for i in pick])
-            self.normalizer.analyze(sample)
+            self.normalizer.analyze(self._augment(sample, train=False))
 
     def create_minibatch_data(self) -> None:
         self.minibatch_data.reset(
-            shape=(self.max_minibatch_size,) + self.sample_shape,
+            shape=(self.max_minibatch_size,) + self.served_shape,
             dtype=np.float32)
         self.minibatch_labels.reset(
             shape=(self.max_minibatch_size,), dtype=np.int32)
@@ -200,14 +256,16 @@ class FileImageLoader(NormalizerStateMixin, Loader):
         indices = self.minibatch_indices.mem
         count = self.minibatch_size
         # fresh buffers per serve — see fullbatch.py fill_minibatch
-        raw = np.zeros((self.max_minibatch_size,) + self.sample_shape,
-                       np.float32)
+        raw = np.zeros((count,) + self.sample_shape, np.float32)
         labels = np.zeros((self.max_minibatch_size,), np.int32)
         for row, idx in enumerate(indices[:count]):
             raw[row] = _decode(self._paths[idx], self.sample_shape)
             labels[row] = self._labels[idx]
-        data = np.zeros_like(raw)
-        data[:count] = self.normalizer.normalize(raw[:count])
+        raw = self._augment(
+            raw, train=int(self.minibatch_class) == TRAIN)
+        data = np.zeros((self.max_minibatch_size,) + self.served_shape,
+                        np.float32)
+        data[:count] = self.normalizer.normalize(raw)
         self.minibatch_data.mem = data
         self.minibatch_labels.mem = labels
 
@@ -229,24 +287,48 @@ class FullBatchImageLoader(FileImageLoader):
 
     def load_data(self) -> None:
         super().load_data()
-        self.original_data.mem = self.normalizer.normalize(np.stack([
-            _decode(p, self.sample_shape) for p in self._paths]))
+        decoded = np.stack([_decode(p, self.sample_shape)
+                            for p in self._paths])
+        if self.augmenting:
+            # augmentation is per-serve: keep the RAW decoded dataset and
+            # crop+normalize in fill_minibatch (the pre-normalized HBM
+            # pinning shortcut does not apply — see ``augmenting``)
+            self.original_data.mem = decoded
+        else:
+            self.original_data.mem = self.normalizer.normalize(decoded)
         self.original_labels.mem = np.asarray(self._labels, np.int32)
 
     def _renormalize_served_data(self) -> None:
         # restore swapped the normalizer in: re-decode from disk (the
-        # tree is still there) instead of keeping a raw in-RAM copy
+        # tree is still there) instead of keeping a second in-RAM copy
+        if self.augmenting:
+            return                    # dataset is stored raw: nothing to redo
         self.original_data.map_invalidate()
         self.original_data.mem = self.normalizer.normalize(np.stack([
             _decode(p, self.sample_shape) for p in self._paths]))
 
+    def served_dataset(self):
+        """The deterministic eval view (FullBatchLoader contract): when
+        augmenting, the stored dataset is RAW — center-crop + normalize
+        it the way a non-train serve would."""
+        data = self.original_data.map_read()
+        if self.augmenting:
+            data = self.normalizer.normalize(self._augment(
+                np.ascontiguousarray(data), train=False))
+        return data, self.original_labels.map_read()
+
     def fill_minibatch(self) -> None:
         indices = self.minibatch_indices.mem
         count = self.minibatch_size
-        data = np.zeros((self.max_minibatch_size,) + self.sample_shape,
-                        np.float32)
         labels = np.zeros((self.max_minibatch_size,), np.int32)
-        data[:count] = self.original_data.mem[indices[:count]]
         labels[:count] = self.original_labels.mem[indices[:count]]
+        data = np.zeros((self.max_minibatch_size,) + self.served_shape,
+                        np.float32)
+        batch = self.original_data.mem[indices[:count]]
+        if self.augmenting:
+            batch = self.normalizer.normalize(self._augment(
+                np.ascontiguousarray(batch),
+                train=int(self.minibatch_class) == TRAIN))
+        data[:count] = batch
         self.minibatch_data.mem = data
         self.minibatch_labels.mem = labels

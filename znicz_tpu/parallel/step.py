@@ -665,6 +665,11 @@ class FusedTrainStep(Unit):
         data_arr = getattr(loader, "original_data", None)
         if loader is None or not data_arr:
             return
+        if getattr(loader, "augmenting", False):
+            # augmenting loaders serve data-dependent minibatches
+            # (mirror/crop per serve) — the index-only shortcut would
+            # silently skip the augmentation
+            return
         if isinstance(self.evaluator, EvaluatorMSE):
             labels_arr = getattr(loader, "original_targets", None)
         else:

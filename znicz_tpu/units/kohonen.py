@@ -174,6 +174,11 @@ class KohonenTrainer(KohonenBase):
         data_arr = getattr(loader, "original_data", None)
         if not self.scan_epoch or loader is None or not data_arr:
             return
+        if getattr(loader, "augmenting", False):
+            # per-serve augmentation is data-dependent: the pinned-scan
+            # shortcut would silently train on the raw uncropped dataset
+            # (same guard as FusedTrainStep._pin_dataset)
+            return
         data = np.asarray(data_arr.mem, np.float32)
         data = data.reshape(data.shape[0], -1)
         limit = int(root.common.engine.get(

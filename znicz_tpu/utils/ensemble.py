@@ -106,8 +106,11 @@ class Ensemble(Logger):
         loader = self.members[0].loader
         off = loader.class_offset(VALID)
         n = loader.class_lengths[VALID]
-        data = loader.original_data.map_read()[off:off + n]
-        labels = loader.original_labels.map_read()[off:off + n]
+        # served_dataset: the deterministic eval view (original_data may
+        # hold RAW data for loaders that augment per serve)
+        all_data, all_labels = loader.served_dataset()
+        data = all_data[off:off + n]
+        labels = all_labels[off:off + n]
         committee_err = int((self.predict_classes(data) != labels).sum())
         member_errs = [
             int((self._member_outputs(w, data).argmax(axis=1) != labels)
