@@ -370,3 +370,35 @@ def test_ensemble_over_augmenting_loader(png_tree):
     assert result["n"] == ens.members[0].loader.class_lengths[1]
     assert 0 <= result["committee_err"] <= result["n"]
     assert len(result["member_errs"]) == 2
+
+
+def test_alexnet_augment_recipe(tmp_path):
+    """alexnet.build(loader_config={'augment': True}): the canonical
+    crop+mirror recipe — decode at input+29, serve random input-size
+    crops on TRAIN (Krizhevsky et al. 2012; the reference pipeline's
+    augmentation options)."""
+    from znicz_tpu.models import alexnet
+
+    d = str(tmp_path / "tree")
+    synthesize_image_dataset(d, n_classes=4, n_per_class=10, size=(61, 61))
+    prng.seed_all(1)
+    w = alexnet.build(max_epochs=1, minibatch_size=8, n_classes=4,
+                      input_size=32, loader_name="file_image",
+                      loader_config={"data_dir": d, "augment": True,
+                                     "valid_fraction": 0.25,
+                                     "fit_samples": 8})
+    w.initialize(device=TPUDevice())
+    assert w.loader.sample_shape == (61, 61, 3)       # decode size
+    assert w.loader.crop == (32, 32) and w.loader.mirror
+    assert w.loader.served_shape == (32, 32, 3)
+    w.loader.run()
+    assert w.loader.minibatch_data.mem.shape[1:] == (32, 32, 3)
+    w.run()
+    assert bool(w.decision.complete)
+
+
+def test_alexnet_augment_rejects_non_image_loader():
+    from znicz_tpu.models import alexnet
+
+    with pytest.raises(ValueError, match="image-file loader"):
+        alexnet.build(loader_config={"augment": True})

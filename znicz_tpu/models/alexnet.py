@@ -64,19 +64,33 @@ def build(max_epochs: int = 1, minibatch_size: int = 128,
           snapshotter_config: dict | None = None) -> StandardWorkflow:
     """``loader_name="file_image"`` + ``loader_config={"data_dir": ...}``
     streams a directory-per-class ImageNet-style tree with fitted
-    mean_disp normalization (the real-data path); the synthetic in-memory
-    loader stays the default so the flagship bench never touches disk."""
-    if loader_name == "file_image":
+    mean_disp normalization (the real-data path); add ``"augment": True``
+    for the canonical AlexNet recipe — decode at ``input_size + 29``
+    (256 for 227) and serve seeded random crops + horizontal mirrors on
+    TRAIN, center crops elsewhere (Krizhevsky et al. 2012, the
+    reference pipeline's augmentation).  The synthetic in-memory loader
+    stays the default so the flagship bench never touches disk."""
+    loader_config = dict(loader_config or {})
+    if loader_config.get("augment") and loader_name not in (
+            "file_image", "full_batch_image"):
+        raise ValueError(f"augment requires an image-file loader "
+                         f"(got loader_name={loader_name!r})")
+    if loader_name in ("file_image", "full_batch_image"):
         cfg = {"sample_shape": (input_size, input_size, 3),
                "minibatch_size": minibatch_size,
                "normalization_type": "mean_disp"}
+        if loader_config.pop("augment", False):
+            # decode larger, serve random input_size crops + mirrors
+            decode = input_size + 29          # 256 for the canonical 227
+            cfg.update({"sample_shape": (decode, decode, 3),
+                        "crop": (input_size, input_size), "mirror": True})
     else:
         cfg = {"n_classes": min(n_classes, 50),
                "sample_shape": (input_size, input_size, 3),
                "n_train": n_train, "n_valid": n_valid,
                "minibatch_size": minibatch_size, "spread": 1.0,
                "noise": 0.5}
-    cfg.update(loader_config or {})
+    cfg.update(loader_config)
     return StandardWorkflow(
         name="AlexNet",
         layers=layers(n_classes=n_classes, lr=lr, dropout=dropout),
