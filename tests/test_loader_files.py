@@ -402,3 +402,33 @@ def test_alexnet_augment_rejects_non_image_loader():
 
     with pytest.raises(ValueError, match="image-file loader"):
         alexnet.build(loader_config={"augment": True})
+
+
+def test_scan_epoch_falls_back_for_augmenting_loader(png_tree):
+    """scan_epoch needs the pinned dataset, which augmenting loaders
+    refuse — the workflow must silently run the per-minibatch path (with
+    augmentation applied) instead of crashing or skipping crops."""
+    from znicz_tpu.core.config import root
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    d = png_tree
+    root.common.engine.scan_epoch = True
+    try:
+        prng.seed_all(11)
+        w = StandardWorkflow(
+            name="AugScan",
+            layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.05}}],
+            loss_function="softmax", loader_name="full_batch_image",
+            loader_config={"data_dir": d, "sample_shape": (12, 10, 3),
+                           "valid_fraction": 0.25, "minibatch_size": 10,
+                           "mirror": True, "crop": (10, 8)},
+            decision_config={"max_epochs": 3}, fused=True)
+        w.initialize(device=TPUDevice())
+        assert w.step._dataset_dev is None       # no pin, no scan fns
+        assert not w.step._scan_idx_fns
+        w.run()
+    finally:
+        root.common.engine.scan_epoch = False
+    hist = [int(h["metric_validation"]) for h in w.decision.metrics_history]
+    assert hist[-1] <= hist[0], hist
