@@ -432,3 +432,49 @@ def test_scan_epoch_falls_back_for_augmenting_loader(png_tree):
         root.common.engine.scan_epoch = False
     hist = [int(h["metric_validation"]) for h in w.decision.metrics_history]
     assert hist[-1] <= hist[0], hist
+
+
+def test_augmented_training_resume_is_bit_exact(tmp_path):
+    """Mid-run resume through an AUGMENTING loader reproduces the exact
+    crop/mirror sequence: the augmentation stream is part of the
+    snapshotted PRNG state, so the continued run is bit-identical."""
+    from znicz_tpu.snapshotter import restore_state
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    d = str(tmp_path / "tree")
+    synthesize_image_dataset(d, n_classes=4, n_per_class=10, size=(12, 10))
+
+    def build(snap_cfg=None):
+        prng.seed_all(91)
+        return StandardWorkflow(
+            name="AugResume",
+            layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.05,
+                            "gradient_moment": 0.9}}],
+            loss_function="softmax", loader_name="full_batch_image",
+            loader_config={"data_dir": d, "sample_shape": (12, 10, 3),
+                           "valid_fraction": 0.25, "minibatch_size": 10,
+                           "mirror": True, "crop": (10, 8)},
+            decision_config={"max_epochs": 4},
+            snapshotter_config=snap_cfg, fused=True)
+
+    snap_dir = tmp_path / "snaps"
+    w_full = build({"directory": str(snap_dir), "prefix": "a",
+                    "only_improved": False, "keep_all": True})
+    w_full.initialize(device=TPUDevice())
+    w_full.run()
+    full_hist = w_full.decision.metrics_history
+    assert len(full_hist) == 4
+
+    w_res = build()
+    w_res.initialize(device=TPUDevice())
+    meta = restore_state(w_res, str(snap_dir / "a_2.npz"))
+    assert meta["loader"]["epoch_number"] == 2
+    w_res.run()
+    assert w_res.decision.metrics_history == full_hist, \
+        (w_res.decision.metrics_history, full_hist)
+    w_full.stop()
+    w_res.stop()
+    np.testing.assert_array_equal(
+        w_full.forwards[0].weights.map_read(),
+        w_res.forwards[0].weights.map_read())
