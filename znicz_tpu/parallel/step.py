@@ -82,8 +82,17 @@ class FusedTrainStep(Unit):
                  optimizer_config: Optional[dict] = None,
                  shard_update: bool = False,
                  clip_norm: Optional[float] = None,
-                 accumulate_steps: int = 1, **kwargs) -> None:
+                 accumulate_steps: int = 1,
+                 ema_decay: Optional[float] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
+        if ema_decay is not None and not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got "
+                             f"{ema_decay}")
+        #: exponential moving average of the params (Polyak averaging,
+        #: beyond-reference): ew/eb leaves updated at every optimizer
+        #: apply, read back via ema_params(), snapshotted with the
+        #: optimizer state.  None = off.
+        self.ema_decay = ema_decay
         if optimizer not in self.OPTIMIZERS:
             raise ValueError(f"unknown optimizer {optimizer!r}; "
                              f"registered: {self.OPTIMIZERS}")
@@ -212,8 +221,29 @@ class FusedTrainStep(Unit):
                 if "b" in leaf:
                     leaf["sb"] = put_v(np.zeros_like(fwd.bias.map_read()))
                 leaf["t"] = put(np.float32(0.0))
+            if self.ema_decay is not None:
+                # EMA mirrors are replicated like the params they track
+                if "w" in leaf:
+                    leaf["ew"] = put(fwd.weights.map_read())
+                if "b" in leaf:
+                    leaf["eb"] = put(fwd.bias.map_read())
             params.append(leaf)
         return params
+
+    def ema_params(self):
+        """Host copies of the Polyak-averaged weights: a list of
+        {"w": ..., "b": ...} dicts in unit order (export/eval view)."""
+        if self.ema_decay is None:
+            raise RuntimeError("ema_decay is not enabled on this step")
+        out = []
+        for leaf in self._params:
+            d = {}
+            if "ew" in leaf:
+                d["w"] = np.asarray(jax.device_get(leaf["ew"]))
+            if "eb" in leaf:
+                d["b"] = np.asarray(jax.device_get(leaf["eb"]))
+            out.append(d)
+        return out
 
     def param_specs(self):
         """Per-leaf PartitionSpecs matching gather_params' placement."""
@@ -263,13 +293,19 @@ class FusedTrainStep(Unit):
         in the PARAM shape (snapshots stay layout-independent: a sharded
         run restores into a replicated one and vice versa)."""
         out = {}
-        if self.optimizer == "sgd" or self._params is None:
+        if self._params is None:
             return out
+        keys = []
+        if self.optimizer == "adam":
+            keys += ["sw", "sb", "t"]
+        if self.ema_decay is not None:
+            keys += ["ew", "eb"]
         for i, leaf in enumerate(self._params):
-            for k in ("sw", "sb", "t"):
+            for k in keys:
                 if k not in leaf:
                     continue
-                if k == "t" or not self.shard_update:
+                if k in ("t", "ew", "eb") or not self.shard_update:
+                    # t is scalar; ew/eb are replicated param mirrors
                     out[f"{i}.{k}"] = np.asarray(jax.device_get(leaf[k]))
                 else:
                     out[f"{i}.{k}"] = self._unshard_state(
@@ -283,7 +319,7 @@ class FusedTrainStep(Unit):
         rep = NamedSharding(self.mesh, P())
         for key, val in arrays.items():
             i, k = key.split(".", 1)
-            if k != "t" and self.shard_update:
+            if k not in ("t", "ew", "eb") and self.shard_update:
                 self._params[int(i)][k] = self._flat_shard_put(val)
             else:
                 self._params[int(i)][k] = jax.device_put(
@@ -549,6 +585,12 @@ class FusedTrainStep(Unit):
                     new["b"], new["vb"] = upd(
                         leaf["b"], grad["b"], leaf["vb"], h["lr_b"],
                         h["wd_b"], h["l1"], h["mom_b"], bs)
+            if self.ema_decay is not None:
+                d = jnp.float32(self.ema_decay)
+                if "ew" in leaf:
+                    new["ew"] = d * leaf["ew"] + (1.0 - d) * new["w"]
+                if "eb" in leaf:
+                    new["eb"] = d * leaf["eb"] + (1.0 - d) * new["b"]
             new_params.append(new)
         return new_params
 

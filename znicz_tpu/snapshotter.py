@@ -151,6 +151,14 @@ def restore_state(workflow, path: str) -> dict:
                 NamedSharding(step.mesh, PartitionSpec()))
         opt = {k[len("step.opt."):]: v for k, v in arrays.items()
                if k.startswith("step.opt.")}
+        has_ema = any(k.split(".", 1)[1] in ("ew", "eb") for k in opt)
+        if has_ema and step.ema_decay is None:
+            # injecting ew/eb into a step whose compiled functions were
+            # built without them would crash later with an opaque
+            # pytree-structure mismatch — fail loudly here instead
+            raise ValueError(
+                "snapshot carries EMA weight mirrors but the workflow "
+                "was built without ema_decay; rebuild with ema_decay set")
         if opt:
             step.load_extra_state(opt)
     return meta

@@ -130,6 +130,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  shard_update: bool = False,
                  clip_norm: Optional[float] = None,
                  accumulate_steps: int = 1,
+                 ema_decay: Optional[float] = None,
                  **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
@@ -153,6 +154,8 @@ class StandardWorkflow(StandardWorkflowBase):
         self.clip_norm = clip_norm
         #: gradient accumulation: optimizer applies every N minibatches
         self.accumulate_steps = accumulate_steps
+        #: Polyak-averaged weight mirror maintained by the fused step
+        self.ema_decay = ema_decay
         if optimizer != "sgd" and not fused:
             raise ValueError(f"optimizer {optimizer!r} requires fused=True "
                              f"(the eager gd units implement SGD only)")
@@ -165,6 +168,9 @@ class StandardWorkflow(StandardWorkflowBase):
                              "gradient view)")
         if accumulate_steps > 1 and not fused:
             raise ValueError("accumulate_steps requires fused=True")
+        if ema_decay is not None and not fused:
+            raise ValueError("ema_decay requires fused=True (the EMA "
+                             "mirror lives in the fused step's params)")
         if clip_norm is not None and clip_norm <= 0:
             raise ValueError(f"clip_norm must be positive, got {clip_norm}"
                              f" (0 freezes training; negative flips the "
@@ -282,7 +288,8 @@ class StandardWorkflow(StandardWorkflowBase):
             defer_metrics=self.defer_metrics, optimizer=self.optimizer,
             optimizer_config=self.optimizer_config,
             shard_update=self.shard_update, clip_norm=self.clip_norm,
-            accumulate_steps=self.accumulate_steps, name="FusedStep")
+            accumulate_steps=self.accumulate_steps,
+            ema_decay=self.ema_decay, name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control
