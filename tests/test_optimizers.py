@@ -647,3 +647,67 @@ def test_ema_snapshots_and_restores():
         w3.initialize(device=TPUDevice())
         with pytest.raises(ValueError, match="EMA weight mirrors"):
             restore_state(w3, path)
+
+
+def test_export_forward_with_ema_weights(tmp_path):
+    """export_forward(use_ema=True) ships the Polyak mirrors; the loaded
+    package predicts with them (serving view), while the default export
+    keeps the raw weights."""
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils.export import ExportedForward, export_forward
+
+    prng.seed_all(21)
+    w = StandardWorkflow(
+        name="emaexp", layers=[{"type": "softmax",
+                                "->": {"output_sample_shape": 3},
+                                "<-": {"learning_rate": 0.2}}],
+        loss_function="softmax", loader_name="synthetic_classifier",
+        loader_config={"n_classes": 3, "sample_shape": (6,),
+                       "n_train": 90, "n_valid": 0,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 2}, ema_decay=0.7)
+    w.initialize(device=TPUDevice())
+    w.run()
+
+    raw_path = export_forward(w, str(tmp_path / "raw.npz"))
+    ema_path = export_forward(w, str(tmp_path / "ema.npz"), use_ema=True)
+    import json
+    raw_w = np.load(raw_path)["0.weights"]
+    with np.load(ema_path) as pkg:
+        ema_w = pkg["0.weights"]
+        assert json.loads(str(pkg["__arch__"]))["ema"] is True
+    with np.load(raw_path) as pkg:
+        assert json.loads(str(pkg["__arch__"]))["ema"] is False
+    assert not np.array_equal(raw_w, ema_w)        # mirrors lag raw
+    np.testing.assert_allclose(ema_w, w.step.ema_params()[0]["w"])
+    # the loaded EMA package runs inference
+    x = np.zeros((4, 6), np.float32)
+    out = ExportedForward(ema_path)(x)
+    assert out.shape == (4, 3)
+
+    # without ema_decay the flag fails loudly
+    import pytest
+    prng.seed_all(22)
+    w2 = StandardWorkflow(
+        name="noema2", layers=[{"type": "softmax",
+                                "->": {"output_sample_shape": 3},
+                                "<-": {"learning_rate": 0.2}}],
+        loss_function="softmax", loader_name="synthetic_classifier",
+        loader_config={"n_classes": 3, "sample_shape": (6,),
+                       "n_train": 30, "n_valid": 0,
+                       "minibatch_size": 10},
+        decision_config={"max_epochs": 1})
+    w2.initialize(device=TPUDevice())
+    w2.run()
+    with pytest.raises(ValueError, match="ema_decay"):
+        export_forward(w2, str(tmp_path / "x.npz"), use_ema=True)
+    # and before initialize: clear error, not a TypeError deep inside
+    prng.seed_all(23)
+    w3 = StandardWorkflow(
+        name="uninit", layers=[{"type": "softmax",
+                                "->": {"output_sample_shape": 3}}],
+        loader_name="synthetic_classifier",
+        loader_config={"n_classes": 3, "sample_shape": (6,)},
+        ema_decay=0.9)
+    with pytest.raises(ValueError, match="initialized"):
+        export_forward(w3, str(tmp_path / "y.npz"), use_ema=True)

@@ -20,26 +20,41 @@ import jax.numpy as jnp
 from znicz_tpu.units.nn_units import MatchingObject
 
 
-def export_forward(workflow, path: str) -> str:
+def export_forward(workflow, path: str, use_ema: bool = False) -> str:
     """Package a StandardWorkflow's forward chain (layer specs + trained
-    weights) into ``path`` (.npz)."""
+    weights) into ``path`` (.npz).  ``use_ema=True`` ships the fused
+    step's Polyak-averaged mirrors instead of the raw weights (the usual
+    serving choice when ``ema_decay`` was on)."""
     if not hasattr(workflow, "layer_specs"):
         raise TypeError("export_forward needs a StandardWorkflow (layer "
                         "specs carry the architecture)")
     step = getattr(workflow, "step", None)
     if step is not None and getattr(step, "_params", None) is not None:
         step.sync_to_units()
+    ema = None
+    if use_ema:
+        if step is None or getattr(step, "ema_decay", None) is None:
+            raise ValueError("use_ema=True needs a fused workflow built "
+                             "with ema_decay")
+        if getattr(step, "_params", None) is None:
+            raise ValueError("use_ema=True needs an initialized workflow "
+                             "(the EMA mirrors live in the step's device "
+                             "params)")
+        ema = step.ema_params()
     arch = []
     arrays = {}
     for i, ((type_name, _unit_name, fwd_kwargs, _gd), fwd) in enumerate(
             zip(workflow.layer_specs, workflow.forwards)):
         arch.append({"type": type_name, "config": fwd_kwargs})
-        for attr in ("weights", "bias"):
+        for attr, ema_key in (("weights", "w"), ("bias", "b")):
             arr = getattr(fwd, attr)
             if arr:
-                arrays[f"{i}.{attr}"] = np.asarray(arr.map_read())
+                if ema is not None and ema_key in ema[i]:
+                    arrays[f"{i}.{attr}"] = np.asarray(ema[i][ema_key])
+                else:
+                    arrays[f"{i}.{attr}"] = np.asarray(arr.map_read())
     meta = {"format": "znicz_tpu.forward", "version": 1, "arch": arch,
-            "name": workflow.name,
+            "name": workflow.name, "ema": bool(use_ema),
             "input_shape": list(workflow.loader.minibatch_data.shape[1:])}
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
