@@ -6,6 +6,7 @@ import os
 import textwrap
 
 import numpy as np
+import pytest
 
 from znicz_tpu.__main__ import main as cli_main
 from znicz_tpu.core import prng
@@ -15,6 +16,14 @@ from znicz_tpu.launcher import Launcher
 from znicz_tpu.models import wine
 from znicz_tpu.utils.ensemble import Ensemble
 from znicz_tpu.utils.genetics import Genetics
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_site_config(monkeypatch, tmp_path_factory):
+    """Isolate every CLI test from the developer machine's site-config
+    layer (env var or ~/.config file)."""
+    monkeypatch.setenv("ZNICZ_TPU_SITE_CONFIG", "")
+    yield
 
 
 WINE_WORKFLOW = textwrap.dedent("""
@@ -354,3 +363,41 @@ def test_forge_cli_errors_are_one_liners(tmp_path, capsys):
                  str(tmp_path / "missing.npz"),
                  "--name", "x", "--version", "1"]) == 2
     assert "forge:" in capsys.readouterr().err
+
+
+def test_site_config_layering(tmp_path, monkeypatch):
+    """Reference layering: site config applies BEFORE workflow configs,
+    so workflow-level settings win; $ZNICZ_TPU_SITE_CONFIG selects it."""
+    site = tmp_path / "site_config.py"
+    site.write_text("root.wine.max_epochs = 9\n"
+                    "root.site_probe.marker = 'site'\n")
+    wf = tmp_path / "wf.py"
+    wf.write_text(WINE_WORKFLOW)
+    cfg = tmp_path / "wine_config.py"
+    cfg.write_text("root.wine.max_epochs = 2\n")   # overrides the site value
+    result_file = tmp_path / "result.json"
+    monkeypatch.setenv("ZNICZ_TPU_SITE_CONFIG", str(site))
+    try:
+        rc = cli_main([str(wf), str(cfg), "--random-seed", "5", "-d", "tpu",
+                       "-o", f"root.wine.result_file={result_file}"])
+        assert rc == 0
+        assert json.loads(result_file.read_text())["epochs"] == 2
+        assert root.site_probe.marker == "site"    # site layer did run
+    finally:
+        for key in ("site_probe", "wine"):
+            if key in root:
+                delattr(root, key)
+
+    from znicz_tpu.__main__ import apply_site_config
+
+    # explicit-but-missing path: loud error, not a silent skip
+    monkeypatch.setenv("ZNICZ_TPU_SITE_CONFIG", str(tmp_path / "nope.py"))
+    with pytest.raises(SystemExit, match="does not exist"):
+        apply_site_config()
+    # empty string disables the layer even if a home-dir file exists
+    monkeypatch.setenv("ZNICZ_TPU_SITE_CONFIG", "")
+    assert apply_site_config() is None
+    # no env var + no home-dir file: silently none
+    monkeypatch.delenv("ZNICZ_TPU_SITE_CONFIG")
+    monkeypatch.setenv("HOME", str(tmp_path / "nohome"))
+    assert apply_site_config() is None

@@ -44,6 +44,31 @@ def _parse_value(text: str):
         return text
 
 
+def apply_site_config() -> str | None:
+    """Reference config layering (SURVEY.md §6.6): package defaults ->
+    SITE config -> workflow config files -> CLI overrides.  The site
+    layer is ``$ZNICZ_TPU_SITE_CONFIG`` when set (empty string disables
+    the layer; a missing file is an error — an explicit path must not be
+    silently skipped), else ``~/.config/znicz_tpu/site_config.py`` when
+    present.  Returns the applied path."""
+    import os
+
+    env = os.environ.get("ZNICZ_TPU_SITE_CONFIG")
+    if env is not None:
+        if env == "":
+            return None                       # layer explicitly disabled
+        if not os.path.isfile(env):
+            raise SystemExit(f"ZNICZ_TPU_SITE_CONFIG={env!r} does not "
+                             f"exist")
+        apply_config_file(env)
+        return env
+    path = os.path.expanduser("~/.config/znicz_tpu/site_config.py")
+    if not os.path.isfile(path):
+        return None
+    apply_config_file(path)
+    return path
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="znicz_tpu",
@@ -141,11 +166,17 @@ def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "forge":
+        site = apply_site_config()            # site may set the forge dir
+        if site:
+            print(f"applied site config {site}", file=sys.stderr)
         return forge_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.coordinator is not None:
         multihost(args.coordinator, args.num_processes, args.process_id)
     prng.seed_all(args.random_seed)
+    site = apply_site_config()
+    if site:
+        print(f"applied site config {site}", file=sys.stderr)
     for cfg in args.configs:
         apply_config_file(cfg)
     for override in args.override:
