@@ -183,6 +183,18 @@ def test_nn_rollback_restores_and_cuts_lr():
     assert rb.rollback_count == 1
     np.testing.assert_array_equal(w.forwards[0].weights.map_read(), good)
     assert w.gds[0].learning_rate == pytest.approx(0.05)
+    # training continues from the restored state: one more fused step
+    # runs with the CUT learning rate (hyper cache re-reads the gd units)
+    import jax
+
+    assert float(jax.device_get(
+        w.step._hyper_device()[0]["lr"])) == pytest.approx(0.05)
+    w.loader.run()
+    w.step.run()
+    w.step.flush_metrics()
+    assert np.isfinite(w.step.loss)
+    w.step.sync_to_units()
+    assert np.isfinite(w.forwards[0].weights.map_read()).all()
 
 
 # -- diversity diagnostic (SURVEY §3.1) --------------------------------------
