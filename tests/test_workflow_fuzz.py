@@ -35,9 +35,10 @@ def layer_stacks(draw):
                              "kx": 3, "ky": 3, "padding": (1, 1, 1, 1)},
                       "<-": dict(HYPER)})
         extra = draw(st.sampled_from(
-            ["none", "max_pooling", "avg_pooling", "stochastic_pooling",
-             "norm", "dropout"]))
-        if extra in ("max_pooling", "avg_pooling", "stochastic_pooling"):
+            ["none", "max_pooling", "maxabs_pooling", "avg_pooling",
+             "stochastic_pooling", "norm", "dropout"]))
+        if extra in ("max_pooling", "maxabs_pooling", "avg_pooling",
+                     "stochastic_pooling"):
             stack.append({"type": extra, "->": {"kx": 2, "ky": 2}})
         elif extra == "norm":
             stack.append({"type": "norm",
