@@ -156,8 +156,9 @@ def forge_main(argv) -> int:
         return 0
     except (KeyError, OSError, FileExistsError) as exc:
         # unknown package/version, missing file, corrupt checksum,
-        # immutable re-upload — one-line error, CLI convention
-        msg = exc.args[0] if exc.args else exc
+        # immutable re-upload — one-line error, CLI convention.  str()
+        # renders OS errors with filename+strerror (args[0] is errno)
+        msg = exc.args[0] if isinstance(exc, KeyError) and exc.args else             str(exc)
         print(f"forge: {msg}", file=sys.stderr)
         return 2
 

@@ -167,7 +167,8 @@ class TextBagOfWordsLoader(NormalizerStateMixin, FullBatchLoader):
             test_docs, test_y = read_corpus(test_path)
         else:
             test_docs, test_y = [], np.zeros(0, np.int32)
-        n_train = self.n_train or len(train_docs)
+        n_train = self.n_train if self.n_train is not None \
+            else len(train_docs)
         n_valid = self.n_valid if self.n_valid is not None \
             else len(test_docs)
         return (test_docs[:n_valid], test_y[:n_valid],
