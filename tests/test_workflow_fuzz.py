@@ -29,7 +29,8 @@ def layer_stacks(draw):
     stack = []
     n_conv_blocks = draw(st.integers(0, 2))
     for _ in range(n_conv_blocks):
-        kind = draw(st.sampled_from(["conv_relu", "conv_tanh", "conv_str"]))
+        kind = draw(st.sampled_from(["conv", "conv_relu", "conv_tanh",
+                                     "conv_str"]))
         stack.append({"type": kind,
                       "->": {"n_kernels": draw(st.sampled_from([4, 8])),
                              "kx": 3, "ky": 3, "padding": (1, 1, 1, 1)},
@@ -48,8 +49,9 @@ def layer_stacks(draw):
             stack.append({"type": "dropout", "->": {"dropout_ratio": 0.2}})
     n_fc = draw(st.integers(0, 2))
     for _ in range(n_fc):
-        kind = draw(st.sampled_from(["all2all_tanh", "all2all_relu",
-                                     "all2all_str", "all2all_sigmoid"]))
+        kind = draw(st.sampled_from(["all2all", "all2all_tanh",
+                                     "all2all_relu", "all2all_str",
+                                     "all2all_sigmoid"]))
         stack.append({"type": kind,
                       "->": {"output_sample_shape":
                              draw(st.sampled_from([8, 16]))},
