@@ -279,3 +279,38 @@ def test_lrn_autograd_matches_hand_backward():
     ein_hand = lrn_ops.backward(jnp, x, err, *args)
     np.testing.assert_allclose(np.asarray(ein_ad), np.asarray(ein_hand),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_avg_pool_fast_grad_under_shard_map(cpu_devices):
+    """Regression: reduce_window-sum with a TRACED init value fails
+    linearization under shard_map ("Linearization failed to produce
+    known values for all output primals") — the init must be a concrete
+    scalar.  Found by the composition fuzzer; pinned here at op level."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from znicz_tpu.ops import pooling
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    mesh = data_parallel_mesh(4)
+
+    def f(x):
+        return pooling.avg_forward_fast(x, 2, 2, 2, 2).sum()
+
+    def local(x):
+        return jax.lax.psum(jax.grad(f)(x), "data")
+
+    x = jnp.arange(8 * 6 * 6 * 3, dtype=jnp.float32).reshape(8, 6, 6, 3)
+    g = jax.jit(shard_map(local, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data")))(x)
+    # each input cell belongs to exactly one full 2x2 window -> grad sums
+    # to the number of output cells per shard times psum over 4 replicas
+    assert g.shape == x.shape
+    np.testing.assert_allclose(np.asarray(g),
+                               np.full(x.shape, 4 * 0.25), rtol=1e-6)
