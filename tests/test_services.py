@@ -255,16 +255,25 @@ def test_trace_summary_reports_top_ops(tmp_path):
 def test_manhole_repl_session():
     """Live-REPL service (the reference's manhole): expressions echo
     their repr, statements exec with stdout captured, errors return a
-    traceback without killing the session."""
+    traceback without killing the session.  The socket is AF_UNIX with
+    0600 permissions — other local uids must not reach the exec REPL."""
+    import os
     import socket
+    import stat
     import time
 
     from znicz_tpu.utils.manhole import Manhole
 
-    hole = Manhole(namespace={"answer": 41}, port=0)
-    port = hole.start()
+    hole = Manhole(namespace={"answer": 41})
+    path = hole.start()
     try:
-        conn = socket.create_connection(("127.0.0.1", port), timeout=5)
+        mode = os.stat(path).st_mode
+        assert stat.S_ISSOCK(mode)
+        assert stat.S_IMODE(mode) == 0o600            # owner-only
+        assert stat.S_IMODE(os.stat(os.path.dirname(path)).st_mode) == 0o700
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(5)
+        conn.connect(path)
         for line in ("answer + 1", "x = answer * 2", "print(x)", "1/0"):
             conn.sendall(line.encode() + b"\n")
         time.sleep(0.5)
@@ -279,16 +288,15 @@ def test_manhole_repl_session():
         conn.close()
     finally:
         hole.stop()
-    # teardown: listener closed, serving thread exited (a post-stop
-    # connect probe would be unsound here: connecting to a free ephemeral
-    # loopback port can self-connect on Linux)
+    # teardown: listener closed, serving thread exited, socket unlinked
     assert hole._sock.fileno() == -1
     assert not hole._thread.is_alive()
+    assert not os.path.exists(path)
 
 
 def test_launcher_serves_manhole():
-    """Launcher with manhole_port=0 serves the live workflow namespace
-    during the run and tears it down after."""
+    """Launcher with manhole_path="" (auto private socket) serves the
+    live workflow namespace during the run and tears it down after."""
     import socket
     import time
 
@@ -296,7 +304,7 @@ def test_launcher_serves_manhole():
     from znicz_tpu.models import wine
 
     prng.seed_all(3)
-    launcher = Launcher(device=TPUDevice(), manhole_port=0)
+    launcher = Launcher(device=TPUDevice(), manhole_path="")
     launcher.load(wine.build, max_epochs=1, n_train=60, n_valid=30,
                   minibatch_size=10)
 
@@ -308,8 +316,9 @@ def test_launcher_serves_manhole():
     def probing_run():
         orig_run()
         if launcher.manhole is not None and "reply" not in seen:
-            conn = socket.create_connection(
-                ("127.0.0.1", launcher.manhole.port), timeout=5)
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(5)
+            conn.connect(launcher.manhole.path)
             conn.sendall(b"wf.name\n")
             time.sleep(0.3)
             seen["reply"] = conn.recv(65536).decode()

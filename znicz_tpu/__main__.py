@@ -93,10 +93,14 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N", help="train N seeded members of the "
                    "workflow and write an ensemble summary JSON "
                    "(reference: --ensemble-train)")
-    p.add_argument("--manhole", type=int, default=None, metavar="PORT",
-                   help="serve a live localhost REPL into the running "
-                        "workflow on PORT (0 = ephemeral; connect with "
-                        "nc 127.0.0.1 PORT)")
+    p.add_argument("--manhole", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="serve a live REPL into the running workflow on a "
+                        "0600-permission UNIX socket (connect with nc -U). "
+                        "Bare --manhole auto-creates a private path; to "
+                        "pick one, use the --manhole=PATH form (the "
+                        "space-separated form would swallow the workflow "
+                        "argument)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
     p.add_argument("--publish", default=None, metavar="BACKEND",
@@ -158,7 +162,8 @@ def forge_main(argv) -> int:
         # unknown package/version, missing file, corrupt checksum,
         # immutable re-upload — one-line error, CLI convention.  str()
         # renders OS errors with filename+strerror (args[0] is errno)
-        msg = exc.args[0] if isinstance(exc, KeyError) and exc.args else             str(exc)
+        msg = (exc.args[0] if isinstance(exc, KeyError) and exc.args
+               else str(exc))
         print(f"forge: {msg}", file=sys.stderr)
         return 2
 
@@ -213,7 +218,7 @@ def main(argv=None) -> int:
     launcher = Launcher(device=make_device(args.device),
                         snapshot=args.snapshot, stealth=args.stealth,
                         profile_dir=args.profile,
-                        manhole_port=args.manhole)
+                        manhole_path=args.manhole)
     if args.optimize is not None:
         if args.publish is not None:
             print("--publish cannot be combined with --optimize "

@@ -35,15 +35,15 @@ class Launcher(Logger):
                  snapshot: Optional[str] = None,
                  stealth: bool = False,
                  profile_dir: Optional[str] = None,
-                 manhole_port: Optional[int] = None) -> None:
+                 manhole_path: Optional[str] = None) -> None:
         super().__init__()
         self.device = device
         self.snapshot = snapshot
         #: stealth: suppress side services (plotters/web) — reference -s
         self.stealth = stealth
-        #: when set, serve a live localhost REPL into the running
-        #: workflow (0 = ephemeral port) — reference's manhole service
-        self.manhole_port = manhole_port
+        #: when set, serve a live REPL into the running workflow on an
+        #: AF_UNIX socket ("" = auto private path) — reference's manhole
+        self.manhole_path = manhole_path
         self.manhole = None
         #: when set, the run is wrapped in ``jax.profiler.trace`` and the
         #: trace lands here (open with TensorBoard / xprof — SURVEY §6.1,
@@ -70,7 +70,7 @@ class Launcher(Logger):
             meta = restore_state(self.workflow, self.snapshot)
             self.info(f"resumed from {self.snapshot} "
                       f"(epoch {meta['loader']['epoch_number']})")
-        if self.manhole_port is not None:
+        if self.manhole_path is not None:
             # explicitly opt-in, so it is served even under --stealth
             # (stealth suppresses the *default* side services)
             from znicz_tpu.core.config import root
@@ -78,7 +78,7 @@ class Launcher(Logger):
             self.manhole = Manhole(
                 namespace={"wf": self.workflow, "launcher": self,
                            "root": root},
-                port=self.manhole_port)
+                path=self.manhole_path)
             self.manhole.start()
         prev = None
         profiling = False

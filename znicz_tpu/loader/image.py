@@ -111,20 +111,34 @@ def ensure_image_tree(data_dir: str, **synth_kwargs) -> str:
     import shutil
 
     vfile = os.path.join(data_dir, ".synth_version")
-    populated = os.path.isdir(data_dir) and bool(os.listdir(data_dir))
-    if populated:
+
+    def _current() -> bool:
+        if not (os.path.isdir(data_dir) and os.listdir(data_dir)):
+            return False
         if not os.path.exists(vfile):
-            return data_dir                       # user-supplied tree
-        if open(vfile).read().strip() == SYNTH_VERSION:
-            return data_dir                       # complete + current
+            return True                           # user-supplied tree
+        with open(vfile) as f:
+            return f.read().strip() == SYNTH_VERSION
+
+    if _current():
+        return data_dir
+    if os.path.isdir(data_dir) and os.listdir(data_dir):
         shutil.rmtree(data_dir)                   # stale recipe: rebuild
     tmp = data_dir.rstrip("/\\") + f".tmp{os.getpid()}"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
     synthesize_image_dataset(tmp, **synth_kwargs)
-    if os.path.isdir(data_dir):                   # empty dir from makedirs
-        os.rmdir(data_dir)
-    os.replace(tmp, data_dir)
+    try:
+        if os.path.isdir(data_dir):               # empty dir from makedirs
+            os.rmdir(data_dir)
+        os.replace(tmp, data_dir)
+    except OSError:
+        # lost a synthesis race: another process renamed its tree into
+        # place first (rmdir ENOTEMPTY / replace over a populated dir).
+        # Use the winner's tree if it validates; drop our tmp either way.
+        shutil.rmtree(tmp, ignore_errors=True)
+        if not _current():
+            raise
     return data_dir
 
 

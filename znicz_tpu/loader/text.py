@@ -146,8 +146,10 @@ class TextBagOfWordsLoader(NormalizerStateMixin, FullBatchLoader):
         missing = [n for n in FILES.values()
                    if not os.path.exists(os.path.join(self.data_dir, n))]
         vfile = os.path.join(self.data_dir, ".synth_version")
-        stale = os.path.exists(vfile) and \
-            open(vfile).read().strip() != SYNTH_VERSION
+        stale = False
+        if os.path.exists(vfile):
+            with open(vfile) as f:
+                stale = f.read().strip() != SYNTH_VERSION
         if not missing and not stale:
             return
         if not self.synthesize:

@@ -2,15 +2,17 @@
 reference's vendored ``veles/external/manhole`` service, SURVEY.md §3.3
 "Misc ext": "manhole = live REPL into a running training").
 
-A background thread serves a line-oriented Python REPL on a localhost TCP
-socket; connect with ``nc 127.0.0.1 <port>`` (or telnet) while training
-runs and inspect the live workflow — ``wf.decision.metrics_history``,
-``wf.step.loss``, pause via gates, etc.  The namespace is handed in by the
-owner (Launcher passes ``wf``/``launcher``/``root``).
+A background thread serves a line-oriented Python REPL on an AF_UNIX
+socket; connect with ``nc -U <path>`` while training runs and inspect the
+live workflow — ``wf.decision.metrics_history``, ``wf.step.loss``, pause
+via gates, etc.  The namespace is handed in by the owner (Launcher passes
+``wf``/``launcher``/``root``).
 
 Design points:
-- binds 127.0.0.1 ONLY (same trust model as the reference: the manhole is
-  a local debugging backdoor, never a network service);
+- AF_UNIX socket with 0600 permissions inside a 0700 directory (the
+  upstream manhole's trust model: filesystem permissions gate access, so
+  other local users on a shared host cannot reach the exec() REPL — a
+  127.0.0.1 TCP port would be open to every local uid);
 - expressions are evaluated and their repr written back; statements are
   exec'd with stdout redirected to the socket; exceptions return their
   traceback instead of killing the connection;
@@ -22,7 +24,9 @@ from __future__ import annotations
 
 import contextlib
 import io
+import os
 import socket
+import tempfile
 import threading
 import traceback
 from typing import Optional
@@ -34,29 +38,53 @@ PROMPT = ">>> "
 
 
 class Manhole(Logger):
-    """Serve a REPL over localhost TCP in a daemon thread."""
+    """Serve a REPL over a 0600-permission AF_UNIX socket in a daemon
+    thread."""
 
     def __init__(self, namespace: Optional[dict] = None,
-                 port: int = 0) -> None:
+                 path: Optional[str] = None) -> None:
         super().__init__()
         self.namespace = dict(namespace or {})
-        self.port = port
+        #: socket path; None/"" = auto-create a private 0700 temp dir
+        self.path = path or None
+        self._own_dir: Optional[str] = None
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
 
-    def start(self) -> int:
-        """Bind and serve; returns the bound port (useful with port=0)."""
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("127.0.0.1", self.port))
+    def start(self) -> str:
+        """Bind and serve; returns the socket path (useful with path=None)."""
+        if self.path is None:
+            # mkdtemp creates the directory 0700 — the socket inside is
+            # unreachable by other uids even before its own chmod lands
+            self._own_dir = tempfile.mkdtemp(prefix="znicz-manhole-")
+            self.path = os.path.join(self._own_dir, "manhole.sock")
+        elif os.path.exists(self.path):
+            # a previous run's stale socket: bind() would raise
+            # EADDRINUSE.  Only ever unlink a socket — a typo'd path
+            # must not delete a user file
+            import stat
+            if not stat.S_ISSOCK(os.lstat(self.path).st_mode):
+                raise FileExistsError(
+                    f"{self.path!r} exists and is not a socket — refusing "
+                    f"to replace it")
+            os.unlink(self.path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # the socket must never exist world-connectable, even for one
+        # instruction under a permissive umask: mask at creation, then
+        # tighten to exactly 0600
+        old_umask = os.umask(0o177)
+        try:
+            self._sock.bind(self.path)
+        finally:
+            os.umask(old_umask)
+        os.chmod(self.path, 0o600)
         self._sock.listen(2)
-        self.port = self._sock.getsockname()[1]
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="manhole")
         self._thread.start()
-        self.info(f"manhole listening on 127.0.0.1:{self.port}")
-        return self.port
+        self.info(f"manhole listening on {self.path} (nc -U {self.path})")
+        return self.path
 
     def stop(self) -> None:
         self._stopping = True
@@ -67,12 +95,20 @@ class Manhole(Logger):
             with contextlib.suppress(OSError):
                 self._sock.shutdown(socket.SHUT_RDWR)
             with contextlib.suppress(OSError):
-                socket.create_connection(("127.0.0.1", self.port),
-                                         timeout=0.2).close()
+                poke = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                poke.settimeout(0.2)
+                poke.connect(self.path)
+                poke.close()
             with contextlib.suppress(OSError):
                 self._sock.close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self.path is not None:
+            with contextlib.suppress(OSError):
+                os.unlink(self.path)
+        if self._own_dir is not None:
+            with contextlib.suppress(OSError):
+                os.rmdir(self._own_dir)
 
     # -- internals ----------------------------------------------------------
     def _serve(self) -> None:
