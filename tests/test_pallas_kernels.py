@@ -416,3 +416,157 @@ def test_pallas_adam_workflow_matches_xla():
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
                                atol=1e-6)
+
+
+# -- round-4 parity tail: conv backward (col2im-as-gather) + deconv pair -----
+
+from znicz_tpu.ops import activations, deconv as deconv_ops
+from znicz_tpu.ops.pallas import (conv2d_backward, deconv2d,
+                                  deconv2d_backward)
+
+
+@pytest.mark.parametrize("geom", CONV_GEOMS)
+def test_pallas_conv_backward_matches_oracle(geom):
+    """err_input/grad_w/grad_b vs the XLA vjp oracle (the linear part of
+    ops.conv.backward) across strides and asymmetric padding."""
+    h, w, cin, cout, k, sliding, padding = geom
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(3, h, w, cin)).astype(np.float32)
+    wts = rng.normal(size=(k, k, cin, cout)).astype(np.float32) * 0.1
+    out_shape = conv_ops.forward_linear(np, x, wts, None, sliding,
+                                        padding).shape
+    err = rng.normal(size=out_shape).astype(np.float32)
+    ei_ref, gw_ref, gb_ref = conv_ops.backward(
+        jnp, jnp.asarray(x), None, jnp.asarray(wts), jnp.asarray(err),
+        sliding, padding, activations.LINEAR, activation_applied=False)
+    ei_pl, gw_pl, gb_pl = conv2d_backward(
+        jnp.asarray(x), jnp.asarray(wts), jnp.asarray(err), sliding,
+        padding, interpret=True)
+    np.testing.assert_allclose(np.asarray(ei_pl), np.asarray(ei_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_pl), np.asarray(gw_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb_pl), np.asarray(gb_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("geom", CONV_GEOMS)
+def test_pallas_deconv_matches_oracle(geom):
+    """deconv2d forward == ops.deconv.forward; deconv2d_backward ==
+    ops.deconv.backward (err_input + grad_w), same geometries."""
+    h, w, cin, cout, k, sliding, padding = geom
+    rng = np.random.default_rng(12)
+    wts = rng.normal(size=(k, k, cin, cout)).astype(np.float32) * 0.1
+    oh = conv_ops.out_size(h, k, sliding[0], *(padding[0], padding[1]))
+    ow = conv_ops.out_size(w, k, sliding[1], *(padding[2], padding[3]))
+    x = rng.normal(size=(3, oh, ow, cout)).astype(np.float32)
+    out_shape = deconv_ops.output_shape_for(x.shape, wts.shape, sliding,
+                                            padding)
+    y_ref = deconv_ops.forward(jnp, jnp.asarray(x), jnp.asarray(wts),
+                               sliding, padding, out_shape)
+    y_pl = deconv2d(jnp.asarray(x), jnp.asarray(wts), sliding, padding,
+                    out_shape, interpret=True)
+    np.testing.assert_allclose(np.asarray(y_pl), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    err = rng.normal(size=out_shape).astype(np.float32)
+    ei_ref, gw_ref = deconv_ops.backward(
+        jnp, jnp.asarray(x), jnp.asarray(wts), jnp.asarray(err), sliding,
+        padding)
+    ei_pl, gw_pl = deconv2d_backward(
+        jnp.asarray(x), jnp.asarray(wts), jnp.asarray(err), sliding,
+        padding, interpret=True)
+    np.testing.assert_allclose(np.asarray(ei_pl), np.asarray(ei_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_pl), np.asarray(gw_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_gd_conv_unit_selection():
+    """root.common.engine.pallas routes GradientDescentConv (incl. the
+    tanh activation correction) through the hand-written backward with
+    identical training effect."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.conv import ConvTanh
+    from znicz_tpu.units.gd_conv import GDTanhConv
+
+    def run_once():
+        prng.seed_all(14)
+        rng = np.random.default_rng(2)
+        w = Workflow(name="g")
+        fwd = ConvTanh(w, n_kernels=6, kx=3, ky=3, sliding=(2, 2),
+                       padding=(1, 1, 1, 1))
+        fwd.input = Array(rng.normal(size=(3, 8, 8, 2)).astype(np.float32))
+        fwd.initialize(device=TPUDevice())
+        fwd.run()
+        gd = GDTanhConv(w, learning_rate=0.1, weights_decay=0.01,
+                        gradient_moment=0.9)
+        gd.link_from_forward(fwd)
+        gd.err_output = Array(rng.normal(size=fwd.output.shape)
+                              .astype(np.float32))
+        gd.batch_size = 3
+        gd.initialize(device=TPUDevice())
+        gd.run()
+        return {a: np.asarray(getattr(gd, a).map_read()).copy()
+                for a in ("err_input", "weights", "bias",
+                          "gradient_weights", "gradient_bias")}
+
+    base = run_once()
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        pallas = run_once()
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    for attr, want in base.items():
+        np.testing.assert_allclose(pallas[attr], want, rtol=1e-4,
+                                   atol=1e-5, err_msg=attr)
+
+
+def test_pallas_deconv_unit_selection():
+    """root.common.engine.pallas routes Deconv + GDDeconv through the
+    hand-written transposed-conv pair with identical results."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.deconv import Deconv
+    from znicz_tpu.units.gd_deconv import GDDeconv
+
+    def run_once():
+        prng.seed_all(15)
+        rng = np.random.default_rng(4)
+        w = Workflow(name="d")
+        fwd = Deconv(w, n_kernels=6, kx=3, ky=3, n_channels=2,
+                     sliding=(2, 2), padding=(1, 1, 1, 1))
+        fwd.input = Array(rng.normal(size=(2, 4, 4, 6)).astype(np.float32))
+        fwd.initialize(device=TPUDevice())
+        fwd.run()
+        gd = GDDeconv(w, learning_rate=0.1, gradient_moment=0.9)
+        gd.link_from_forward(fwd)
+        gd.err_output = Array(rng.normal(size=fwd.output.shape)
+                              .astype(np.float32))
+        gd.batch_size = 2
+        gd.initialize(device=TPUDevice())
+        gd.run()
+        return {"out": np.asarray(fwd.output.map_read()).copy(),
+                "err_input": np.asarray(gd.err_input.map_read()).copy(),
+                "weights": np.asarray(gd.weights.map_read()).copy(),
+                "vel": np.asarray(gd.gradient_weights.map_read()).copy()}
+
+    base = run_once()
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        pallas = run_once()
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    for attr, want in base.items():
+        np.testing.assert_allclose(pallas[attr], want, rtol=1e-4,
+                                   atol=1e-5, err_msg=attr)

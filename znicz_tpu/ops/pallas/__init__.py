@@ -17,6 +17,8 @@ from znicz_tpu.ops.pallas.sgd import fused_sgd_update  # noqa: F401
 from znicz_tpu.ops.pallas.dropout import dropout_forward  # noqa: F401
 from znicz_tpu.ops.pallas.lrn import lrn_backward, lrn_forward  # noqa: F401
 from znicz_tpu.ops.pallas.conv import conv2d_im2col  # noqa: F401
+from znicz_tpu.ops.pallas.conv_bwd import (  # noqa: F401
+    conv2d_backward, deconv2d, deconv2d_backward)
 from znicz_tpu.ops.pallas.pooling import stochastic_pool  # noqa: F401
 from znicz_tpu.ops.pallas.kohonen import som_step  # noqa: F401
 from znicz_tpu.ops.pallas.attention import flash_attention  # noqa: F401

@@ -97,11 +97,22 @@ class Deconv(Forward):
             self.output.shape)
 
     def xla_init(self) -> None:
+        from znicz_tpu.core.config import root
+
         sliding, padding, out_shape = \
             self.sliding, self.padding, self.output.shape
+        if bool(root.common.engine.get("pallas", False)):
+            # hand-written scatter-as-gather transposed conv (parity path)
+            from znicz_tpu.ops.pallas import deconv2d
+            interp = bool(root.common.engine.get("pallas_interpret", False))
 
-        def fn(x, w):
-            return deconv_ops.forward(jnp, x, w, sliding, padding, out_shape)
+            def fn(x, w):
+                return deconv2d(x, w, sliding, padding, out_shape,
+                                interpret=interp)
+        else:
+            def fn(x, w):
+                return deconv_ops.forward(jnp, x, w, sliding, padding,
+                                          out_shape)
 
         self._xla_fn = jax.jit(fn)
 

@@ -43,10 +43,13 @@ class GradientDescentConv(GradientDescentBase):
         self.init_array(self.err_input, self.err_output,
                         self.gradient_weights, self.gradient_bias)
 
-    def _step(self, xp, x, y, w, b, err_out, vel_w, vel_b, batch_size):
-        err_in, grad_w, grad_b = conv_ops.backward(
+    def _backward(self, xp, x, y, w, err_out):
+        return conv_ops.backward(
             xp, x, y, w, err_out, self.sliding, self.padding,
             self.ACTIVATION, activation_applied=True)
+
+    def _step(self, xp, x, y, w, b, err_out, vel_w, vel_b, batch_size):
+        err_in, grad_w, grad_b = self._backward(xp, x, y, w, err_out)
         if not self.need_err_input:
             err_in = None
         if self.apply_gradient:
@@ -82,6 +85,27 @@ class GradientDescentConv(GradientDescentBase):
             self.gradient_bias.mem = vel_b
 
     def xla_init(self) -> None:
+        from znicz_tpu.core.config import root
+
+        if bool(root.common.engine.get("pallas", False)):
+            # hand-written col2im-as-gather + transposed-tap-GEMM pair
+            # (parity path; XLA's vjp conv is the default)
+            from znicz_tpu.ops.pallas import conv2d_backward
+            interp = bool(root.common.engine.get("pallas_interpret", False))
+            act, sliding, padding = \
+                self.ACTIVATION, self.sliding, self.padding
+
+            def pallas_backward(xp, x, y, w, err_out):
+                err_v = activations.backward(jnp, act, y, err_out)
+                return conv2d_backward(x, w, err_v, sliding, padding,
+                                       interpret=interp)
+
+            self._backward = pallas_backward
+        else:
+            # drop a stale instance override from a previous initialize
+            # under engine.pallas — the flag must toggle both ways
+            self.__dict__.pop("_backward", None)
+
         def fn(x, y, w, b, err_out, vel_w, vel_b, batch_size):
             return self._step(jnp, x, y, w, b, err_out, vel_w, vel_b,
                               batch_size)

@@ -40,9 +40,12 @@ class GDDeconv(GradientDescentBase):
         self.init_array(self.err_input, self.err_output,
                         self.gradient_weights)
 
-    def _step(self, xp, x, w, err_out, vel_w, batch_size):
-        err_in, grad_w = deconv_ops.backward(
+    def _backward(self, xp, x, w, err_out):
+        return deconv_ops.backward(
             xp, x, w, err_out, self.sliding, self.padding)
+
+    def _step(self, xp, x, w, err_out, vel_w, batch_size):
+        err_in, grad_w = self._backward(xp, x, w, err_out)
         if not self.need_err_input:
             err_in = None
         if self.apply_gradient:
@@ -65,6 +68,24 @@ class GDDeconv(GradientDescentBase):
         self.gradient_weights.mem = vel_w
 
     def xla_init(self) -> None:
+        from znicz_tpu.core.config import root
+
+        if bool(root.common.engine.get("pallas", False)):
+            # forward-conv + swapped-roles grad kernels (parity path)
+            from znicz_tpu.ops.pallas import deconv2d_backward
+            interp = bool(root.common.engine.get("pallas_interpret", False))
+            sliding, padding = self.sliding, self.padding
+
+            def pallas_backward(xp, x, w, err_out):
+                return deconv2d_backward(x, w, err_out, sliding, padding,
+                                         interpret=interp)
+
+            self._backward = pallas_backward
+        else:
+            # drop a stale instance override from a previous initialize
+            # under engine.pallas — the flag must toggle both ways
+            self.__dict__.pop("_backward", None)
+
         def fn(x, w, err_out, vel_w, batch_size):
             return self._step(jnp, x, w, err_out, vel_w, batch_size)
 

@@ -88,7 +88,8 @@ class Manhole(Logger):
 
     def stop(self) -> None:
         self._stopping = True
-        if self._sock is not None:
+        bound = self._sock is not None
+        if bound:
             # closing a listening socket does not reliably wake a thread
             # blocked in accept() on Linux — shut it down first, and poke
             # it with a throwaway connect so the acceptor observes EOF
@@ -103,7 +104,9 @@ class Manhole(Logger):
                 self._sock.close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-        if self.path is not None:
+        # only remove what THIS instance created: a stop() on a
+        # never-started manhole must not delete a foreign file/socket
+        if bound and self.path is not None:
             with contextlib.suppress(OSError):
                 os.unlink(self.path)
         if self._own_dir is not None:
