@@ -217,24 +217,28 @@ def bench_cifar(batch=512, K=16, reps=3):
           w.forwards, batch)
 
 
-def bench_deconv_ae(batch=256, K=16, reps=3):
-    """BASELINE.md config 4: Conv -> Deconv reconstruction autoencoder."""
+def bench_deconv_ae(batch=64, K=8, reps=3):
+    """BASELINE.md config 4 at ImagenetAE-representative scale: 64x64x3
+    input, 64/128-kernel strided conv encoder, mirrored deconv decoder.
+    (The r1-r3 32x32x1/32-kernel toy measured model smallness, not the
+    deconv path — VERDICT r3 weak #3.)"""
     import numpy as np
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
-    from znicz_tpu.models.autoencoder import build
+    from znicz_tpu.models.autoencoder import build_deep
 
     t0 = time.time()
     prng.seed_all(7)
-    w = build(max_epochs=1, minibatch_size=batch, sample_shape=(32, 32, 1),
-              n_kernels=32, n_train=batch, n_valid=0)
+    w = build_deep(max_epochs=1, minibatch_size=batch,
+                   sample_shape=(64, 64, 3), n_kernels=(64, 128),
+                   n_train=batch, n_valid=0)
     w.initialize(device=TPUDevice())
     print(f"# deconv_ae: initialized in {time.time() - t0:.1f}s",
           file=sys.stderr)
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch, 32, 32, 1)).astype(np.float32)
+    x = rng.normal(size=(batch, 64, 64, 3)).astype(np.float32)
     sps = _throughput(w.step, x, x, K, reps)   # identity targets (MSE)
-    _emit(f"deconv_ae_b{batch}_train_samples_per_sec_per_chip", sps,
+    _emit(f"deconv_ae64_b{batch}_train_samples_per_sec_per_chip", sps,
           w.forwards, batch)
 
 
@@ -259,8 +263,9 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
     tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
     labels = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1))
     from znicz_tpu.ops.pallas.attention import supported as flash_ok
-    attention = "flash" if (tfm._flash_eligible(mesh, False) and
-                            flash_ok(seq, d // heads)) else "xla"
+    attempted_flash = (tfm._flash_eligible(mesh, False) and
+                       flash_ok(seq, d // heads))
+    attention = "flash" if attempted_flash else "xla"
     try:
         prng.seed_all(7)
         params = tfm.init_params(prng.get(), n_layers, d, heads, 4 * d,
@@ -301,8 +306,43 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
     extra = {}
     if peak and jax.default_backend() != "cpu":
         extra["mfu"] = round(6.0 * n_params * tps / peak, 4)
+    if attention == "xla" and jax.default_backend() != "cpu":
+        # the headline kernel must never silently die on hardware
+        # (VERDICT r3 weak #5) — make the degradation loud, and say
+        # which kind it was: a lowering failure leaves an error on
+        # stderr; an ineligible/disabled geometry never attempted flash
+        extra["warning"] = (
+            "flash attention did not lower on TPU — XLA fallback "
+            "measured; see stderr for the lowering error"
+            if attempted_flash else
+            "flash attention ineligible for this config (disabled or "
+            "unsupported geometry) — XLA attention measured")
+        print(f"# WARNING: transformer measured with XLA attention on "
+              f"real TPU ({'lowering failure' if attempted_flash else 'flash ineligible'})",
+              file=sys.stderr)
     _emit(f"transformer_l{n_layers}d{d}s{seq}_train_tokens_per_sec_per_chip",
           tps, unit="tokens/sec", attention=attention, **extra)
+
+
+def bench_pallas_parity():
+    """VERDICT r3 item 4: every Pallas kernel family executed COMPILED
+    (interpret=False) on the real chip against its oracle — one
+    ``pallas_hw_parity`` line, per-kernel ok/FAIL, lowering failure is a
+    FAIL (never a silent fallback)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        print("# pallas_hw_parity skipped: no TPU backend", file=sys.stderr)
+        return
+    from znicz_tpu.utils.pallas_hw import run_parity
+
+    t0 = time.time()
+    kernels = run_parity(interpret=False)
+    n_ok = sum(1 for v in kernels.values() if v == "ok")
+    print(f"# pallas_hw_parity: {n_ok}/{len(kernels)} in "
+          f"{time.time() - t0:.1f}s", file=sys.stderr)
+    _emit("pallas_hw_parity_kernels_ok", float(n_ok), unit="kernels",
+          total=len(kernels), kernels=kernels)
 
 
 def bench_kohonen(n_train=4000, minibatch=500, epochs=3):
@@ -399,7 +439,8 @@ def child_main(mode: str) -> None:
     # remaining BASELINE configs; every line above already landed, so a
     # timeout here only truncates the tail
     for phase in (bench_cifar, bench_deconv_ae, bench_kohonen,
-                  bench_mnist_wallclock, bench_transformer):
+                  bench_mnist_wallclock, bench_transformer,
+                  bench_pallas_parity):
         try:
             phase()
         except Exception as exc:  # noqa: BLE001 — keep earlier results

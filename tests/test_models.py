@@ -228,3 +228,22 @@ def test_image_ae_sample():
     assert np.any(w.loader.minibatch_data.mem)
     np.testing.assert_array_equal(w.loader.minibatch_targets.mem,
                                   w.loader.minibatch_data.mem)
+
+
+def test_deep_autoencoder_sample():
+    """ImagenetAE-scale builder (BASELINE.md config 4 at representative
+    geometry): strided conv pyramid mirrors back to the input shape and
+    the reconstruction improves over epochs.  (Exact pin omitted: this
+    builder's bench geometry is 64x64x3 — the test uses a shrunk variant
+    and pins the trend plus the round-trip shape contract.)"""
+    prng.seed_all(7)
+    w = autoencoder.build_deep(max_epochs=3, minibatch_size=16,
+                               sample_shape=(16, 16, 3),
+                               n_kernels=(8, 16), n_train=64)
+    w.initialize(device=TPUDevice())
+    w.run()
+    hist = w.decision.metrics_history
+    assert w.forwards[-1].output.shape[1:] == (16, 16, 3)
+    spatial = [f.output.shape[1] for f in w.forwards]
+    assert spatial == [8, 4, 8, 16], spatial      # halve, halve, mirror
+    assert hist[-1]["metric_train"] < hist[0]["metric_train"], hist

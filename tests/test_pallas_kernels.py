@@ -570,3 +570,17 @@ def test_pallas_deconv_unit_selection():
     for attr, want in base.items():
         np.testing.assert_allclose(pallas[attr], want, rtol=1e-4,
                                    atol=1e-5, err_msg=attr)
+
+
+def test_pallas_hw_parity_sweep_interpret():
+    """The compiled-mode hardware sweep (bench.py::bench_pallas_parity)
+    must cover every kernel family and pass fully under the interpreter —
+    so a chip-window run can only fail for hardware/lowering reasons."""
+    from znicz_tpu.utils.pallas_hw import run_parity
+
+    res = run_parity(interpret=True)
+    assert set(res) == {"sgd", "adam", "dropout", "lrn", "conv_fwd",
+                        "conv_bwd", "deconv", "stochastic_pool",
+                        "kohonen", "flash_attention"}
+    bad = {k: v for k, v in res.items() if v != "ok"}
+    assert not bad, bad

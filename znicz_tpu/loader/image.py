@@ -123,7 +123,12 @@ def ensure_image_tree(data_dir: str, **synth_kwargs) -> str:
     if _current():
         return data_dir
     if os.path.isdir(data_dir) and os.listdir(data_dir):
-        shutil.rmtree(data_dir)                   # stale recipe: rebuild
+        # stale recipe: rebuild.  A concurrent rebuilder may be deleting
+        # or replacing the same tree — tolerate the shared deletion and
+        # re-check: if a winner already installed a current tree, use it
+        shutil.rmtree(data_dir, ignore_errors=True)
+        if _current():
+            return data_dir
     tmp = data_dir.rstrip("/\\") + f".tmp{os.getpid()}"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)

@@ -37,6 +37,43 @@ def build(max_epochs: int = 10, minibatch_size: int = 50,
         snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
 
 
+def deep_layers(sample_shape, n_kernels=(64, 128), lr: float = 0.001):
+    """ImagenetAE-scale encoder/decoder stack (reference:
+    tests/research/ImagenetAE — strided conv pyramid mirrored by a deconv
+    pyramid).  ``k4 s2 p1`` halves/doubles the spatial size exactly, so
+    the decoder round-trips the encoder for any power-of-two input."""
+    geom = {"kx": 4, "ky": 4, "sliding": (2, 2), "padding": (1, 1, 1, 1)}
+    gd = {"learning_rate": lr, "gradient_moment": 0.9}
+    k1, k2 = n_kernels
+    return [
+        {"type": "conv_relu", "->": {"n_kernels": k1, **geom}, "<-": gd},
+        {"type": "conv_relu", "->": {"n_kernels": k2, **geom}, "<-": gd},
+        {"type": "deconv", "->": {"n_kernels": k2, "n_channels": k1,
+                                  **geom}, "<-": gd},
+        {"type": "deconv", "->": {"n_kernels": k1,
+                                  "n_channels": sample_shape[-1],
+                                  **geom}, "<-": gd},
+    ]
+
+
+def build_deep(max_epochs: int = 10, minibatch_size: int = 64,
+               sample_shape=(64, 64, 3), n_train: int = 256,
+               n_valid: int = 0, n_kernels=(64, 128), fused: bool = True,
+               mesh=None,
+               snapshotter_config: dict | None = None) -> StandardWorkflow:
+    """BASELINE.md config 4 at representative scale: 64x64x3 input,
+    64/128-kernel strided encoder, mirrored deconv decoder (the toy
+    32x32x1/32-kernel geometry cannot carry perf signal — VERDICT r3)."""
+    return StandardWorkflow(
+        name="DeepConvAE", layers=deep_layers(sample_shape, n_kernels),
+        loss_function="mse", loader_name="synthetic_regression",
+        loader_config={"sample_shape": tuple(sample_shape), "identity": True,
+                       "n_train": n_train, "n_valid": n_valid,
+                       "minibatch_size": minibatch_size},
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+
+
 def run(load, main):
     load(build)
     main()

@@ -61,14 +61,27 @@ class Manhole(Logger):
             self.path = os.path.join(self._own_dir, "manhole.sock")
         elif os.path.exists(self.path):
             # a previous run's stale socket: bind() would raise
-            # EADDRINUSE.  Only ever unlink a socket — a typo'd path
-            # must not delete a user file
+            # EADDRINUSE.  Only ever unlink a DEAD socket — a typo'd
+            # path must not delete a user file, and a live manhole
+            # served by another process must not be stolen (a probe
+            # connect succeeding means someone is accepting there)
             import stat
             if not stat.S_ISSOCK(os.lstat(self.path).st_mode):
                 raise FileExistsError(
                     f"{self.path!r} exists and is not a socket — refusing "
                     f"to replace it")
-            os.unlink(self.path)
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(0.2)
+            try:
+                probe.connect(self.path)
+            except OSError:
+                os.unlink(self.path)             # nobody accepting: stale
+            else:
+                raise FileExistsError(
+                    f"{self.path!r} is a live socket served by another "
+                    f"process — refusing to steal it")
+            finally:
+                probe.close()
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         # the socket must never exist world-connectable, even for one
         # instruction under a permissive umask: mask at creation, then
