@@ -90,7 +90,10 @@ def test_cli_end_to_end(tmp_path):
     del root.wine
 
 
-def test_cli_optimize(tmp_path):
+def test_cli_optimize(tmp_path, capsys):
+    """An lr-only Tune over a fused StandardWorkflow must route through
+    the VMAPPED population evaluator (SURVEY.md §3.4 hyperparameter
+    parallelism), not the sequential full-run loop."""
     wf = tmp_path / "wine_opt.py"
     wf.write_text(textwrap.dedent("""
         from znicz_tpu.core.config import root
@@ -104,7 +107,29 @@ def test_cli_optimize(tmp_path):
     set_by_path(root, "wine_opt.lr", Tune(0.3, 0.01, 1.0))
     rc = cli_main([str(wf), "--optimize", "2", "-d", "tpu"])
     assert rc == 0
+    assert "'_evaluator': 'vmapped'" in capsys.readouterr().out
     del root.wine_opt
+
+
+def test_cli_optimize_structural_tune_falls_back(tmp_path, capsys):
+    """A Tune that changes workflow STRUCTURE (hidden layer size) cannot
+    batch — the probe must detect it and fall back to sequential runs."""
+    wf = tmp_path / "wine_hidden.py"
+    wf.write_text(textwrap.dedent("""
+        from znicz_tpu.core.config import root
+        from znicz_tpu.models import wine
+
+        def run(load, main):
+            load(wine.build, max_epochs=1, n_train=30, n_valid=10,
+                 minibatch_size=10,
+                 hidden=int(root.wine_hidden.hidden))
+            main()
+        """))
+    set_by_path(root, "wine_hidden.hidden", Tune(8, 4, 16))
+    rc = cli_main([str(wf), "--optimize", "1", "-d", "tpu"])
+    assert rc == 0
+    assert "'_evaluator': 'sequential'" in capsys.readouterr().out
+    del root.wine_hidden
 
 
 def test_genetics_pure_function():
@@ -155,7 +180,10 @@ def test_ga_evaluations_share_one_seed_and_private_stream(monkeypatch):
     prng.seed_all(9)
     gmod.optimize(FakeModule, _FakeLauncher(), generations=2,
                   population_size=4)
-    assert len(seen) == 8
+    # 8 evaluations + 1 vmap-compatibility probe build (the fake
+    # workflow is not a fused StandardWorkflow, so the probe rejects it
+    # after the base build and evaluation runs sequentially)
+    assert len(seen) == 9
     assert len(set(seen)) == 1, \
         f"evaluations saw drifting session seeds: {seen}"
     del root.ga_seed_test

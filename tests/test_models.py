@@ -247,3 +247,26 @@ def test_deep_autoencoder_sample():
     spatial = [f.output.shape[1] for f in w.forwards]
     assert spatial == [8, 4, 8, 16], spatial      # halve, halve, mirror
     assert hist[-1]["metric_train"] < hist[0]["metric_train"], hist
+
+
+def test_mnist_conv_bf16_convergence_pin():
+    """Tier-2 convergence under the bf16 precision policy (VERDICT r3
+    weak #4): the SAME seeded MNIST-conv run as the 2%-test, forced
+    through compute_dtype=bfloat16, with its own exact pinned early
+    trajectory and converged tail — so a precision-policy regression
+    (e.g. an accumulation silently moved to bf16) fails CI as a degraded
+    converged metric, not just a loose "tracks f32" check.  bf16
+    rounding on this platform is deterministic: the pin is exact
+    (captured twice, bit-identical)."""
+    import jax.numpy as jnp
+
+    prng.seed_all(31)
+    w = mnist_conv.build(max_epochs=12, minibatch_size=100, n_train=2000,
+                         n_valid=500)
+    w.step.compute_dtype = jnp.bfloat16
+    w.initialize(device=TPUDevice())
+    w.run()
+    val = [int(h["metric_validation"]) for h in w.decision.metrics_history]
+    # f32 pin for the same seed/config: [451, 443, 411, 315, 228, 128]
+    assert val[:6] == [451, 446, 411, 322, 227, 129], val
+    assert val[-1] <= 10, val    # converged: <= 2% of 500

@@ -65,6 +65,30 @@ from znicz_tpu.units.all2all import All2AllSoftmax
 from znicz_tpu.units.evaluator import EvaluatorMSE, EvaluatorSoftmax
 
 
+def full_batch_arrays(loader, mse: bool):
+    """The ONE place that decides whether a loader exposes a static
+    full-batch dataset: returns ``(data_arr, labels_arr, None)`` or
+    ``(None, None, reason)``.  Shared by the HBM dataset pinning
+    (:meth:`FusedTrainStep._pin_dataset`) and the vmapped population
+    evaluator (utils/genetics) so the loader contract lives in one
+    function."""
+    if loader is None:
+        return None, None, "no loader"
+    data_arr = getattr(loader, "original_data", None)
+    if not data_arr:
+        return None, None, "loader exposes no original_data"
+    if getattr(loader, "augmenting", False):
+        # augmenting loaders serve data-dependent minibatches
+        # (mirror/crop per serve) — a static array stack would
+        # silently skip the augmentation
+        return None, None, "augmenting loader"
+    labels_arr = getattr(
+        loader, "original_targets" if mse else "original_labels", None)
+    if not labels_arr:
+        return None, None, "loader exposes no labels/targets array"
+    return data_arr, labels_arr, None
+
+
 class FusedTrainStep(Unit):
     """One-unit replacement for the accelerated segment of the graph."""
 
@@ -704,19 +728,9 @@ class FusedTrainStep(Unit):
         self._dataset_dev = None
         self._train_fn_idx = self._eval_fn_idx = None
         loader = self.loader
-        data_arr = getattr(loader, "original_data", None)
-        if loader is None or not data_arr:
-            return
-        if getattr(loader, "augmenting", False):
-            # augmenting loaders serve data-dependent minibatches
-            # (mirror/crop per serve) — the index-only shortcut would
-            # silently skip the augmentation
-            return
-        if isinstance(self.evaluator, EvaluatorMSE):
-            labels_arr = getattr(loader, "original_targets", None)
-        else:
-            labels_arr = getattr(loader, "original_labels", None)
-        if not labels_arr:
+        data_arr, labels_arr, _why = full_batch_arrays(
+            loader, mse=isinstance(self.evaluator, EvaluatorMSE))
+        if data_arr is None:
             return
         limit = int(root.common.engine.get(
             "dataset_on_device_max_bytes", 1 << 30))

@@ -3,10 +3,15 @@
 
 Config leaves wrapped in ``Tune(default, min, max)`` (znicz_tpu.core.config)
 define the search space; each individual is a {dotted_path: value}
-assignment over the global ``root`` tree; fitness is the Decision's best
-validation metric of a complete (usually shrunk) training run.  Selection
-is top-half elitist, crossover uniform per-gene, mutation gaussian within
-the Tune range — the reference's GA shape (veles/genetics/core.py).
+assignment over the global ``root`` tree.  Fitness protocols (consistent
+within a run, chosen by the routing below): the SEQUENTIAL path scores
+the Decision's BEST validation metric of a complete (usually shrunk)
+training run, early stopping and all; the VMAPPED path scores the
+FINAL-epoch validation metric after exactly ``max_epochs`` scanned
+epochs (no early stopping — a scanned program has a static trip count).
+Selection is top-half elitist, crossover uniform per-gene, mutation
+gaussian within the Tune range — the reference's GA shape
+(veles/genetics/core.py).
 
 The reference parallelizes evaluation by farming individuals to ZeroMQ
 slaves; the TPU rebuild turns the population into a BATCHED AXIS instead:
@@ -14,9 +19,14 @@ slaves; the TPU rebuild turns the population into a BATCHED AXIS instead:
 by ``jax.vmap``-ing the fused train step over a population-stacked
 hyperparameter pytree (SURVEY.md §3.4 "hyperparameter parallelism").
 Pass it to ``Genetics(evaluate_many=...)`` to score whole generations in
-one compiled dispatch.  The generic CLI ``--optimize`` path stays
-sequential — arbitrary Tune paths may change shapes (layer sizes), which
-no vmap can batch.
+one compiled dispatch.
+
+The CLI ``--optimize`` path routes through the vmapped evaluator
+automatically when the workflow qualifies: a fused StandardWorkflow whose
+Tune leaves move only per-layer hyperparams (probed by rebuilding the
+workflow at each Tune extreme and comparing the structural signature —
+arbitrary Tune paths may change shapes, e.g. layer sizes, which no vmap
+can batch; those fall back to the sequential full-run loop).
 """
 
 from __future__ import annotations
@@ -29,7 +39,8 @@ from znicz_tpu.core.config import (root, set_by_path, walk_tunes)
 from znicz_tpu.core.logger import Logger
 
 
-def make_population_evaluator(step):
+def make_population_evaluator(step, metric: str = "n_err",
+                              epochs: int = 1):
     """Build a reusable batched fitness scorer over ``step``.
 
     The returned callable
@@ -61,8 +72,14 @@ def make_population_evaluator(step):
                 p, k2 = carry
                 p, k2, _ = step._local_train(p, k2, hyper, *inp)
                 return (p, k2), None
-            (p, _), _ = jax.lax.scan(body, (params, k), (xs, ys, ms))
-            return step._local_eval(p, ex, ey, em)["n_err"]
+
+            def epoch(carry, _):
+                carry, _ = jax.lax.scan(body, carry, (xs, ys, ms))
+                return carry, None
+
+            (p, _), _ = jax.lax.scan(epoch, (params, k), None,
+                                     length=epochs)
+            return step._local_eval(p, ex, ey, em)[metric]
 
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
             jnp.arange(n_pop))
@@ -169,17 +186,188 @@ class Genetics(Logger):
         return best, best_fit
 
 
+class NotVmappable(Exception):
+    """The workflow/Tune combination cannot ride the batched evaluator."""
+
+
+def _build_only(module, seed: int):
+    """Build the module's workflow under the current ``root`` values —
+    no device init, no training (``main`` is a no-op)."""
+    prng.seed_all(seed)
+    holder = {}
+
+    def load(builder, **kwargs):
+        holder["w"] = builder(**kwargs)
+        return holder["w"], False
+
+    def main(**_):
+        pass
+
+    module.run(load, main)
+    return holder.get("w")
+
+
+#: gd-unit attributes the fused step reads as traced hyperparams
+#: (FusedTrainStep.hyper_params) — the ONLY things a Tune may move for
+#: the vmapped path to be sound
+_HYPER_ATTRS = frozenset({
+    "learning_rate", "weights_decay", "l1_vs_l2", "gradient_moment",
+    "learning_rate_bias", "weights_decay_bias", "gradient_moment_bias"})
+
+
+def _static_signature(w) -> tuple:
+    """Hashable summary of everything about a built workflow EXCEPT the
+    fused hyperparams: unit classes and their static scalar attrs.  Two
+    individuals with equal signatures compile to the same program and
+    differ only in traced scalars."""
+    def attrs(u, exclude=frozenset()):
+        out = []
+        for k in sorted(vars(u)):
+            if k.startswith("_") or k in exclude:
+                continue
+            v = vars(u)[k]
+            if isinstance(v, (bool, int, float, str)) or (
+                    isinstance(v, tuple) and
+                    all(isinstance(e, (bool, int, float, str))
+                        for e in v)):
+                out.append((k, v))
+        return tuple(out)
+
+    return (type(w).__name__, w.loss_function, w.optimizer,
+            (type(w.loader).__name__, attrs(w.loader)),
+            tuple((type(f).__name__, attrs(f)) for f in w.forwards),
+            tuple((type(g).__name__, attrs(g, _HYPER_ATTRS))
+                  for g in w.step.gds))
+
+
+def _try_vmapped_evaluator(module, launcher, eval_seed: int, tunes: dict,
+                           log: Logger):
+    """Stand up the batched ``evaluate_many`` for the CLI path, or raise
+    :class:`NotVmappable` with the reason.
+
+    Compatibility is established by construction, not by parsing Tune
+    paths: the workflow is rebuilt at each Tune extreme and its
+    structural signature must be unchanged — then per individual the
+    rebuild's ``hyper_params()`` IS the mapping from config values to
+    traced scalars, exactly as the builder computes it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from znicz_tpu.loader.base import TRAIN, VALID
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    for path, t in tunes.items():
+        set_by_path(root, path, t.default)
+    base = _build_only(module, eval_seed)
+    if not isinstance(base, StandardWorkflow) or not base.fused or \
+            getattr(base, "step", None) is None:
+        raise NotVmappable("workflow is not a fused StandardWorkflow")
+    base_sig = _static_signature(base)
+    for path, t in tunes.items():
+        # probe BOTH extremes: a structure change that triggers only
+        # below/above some threshold must not slip past a one-sided probe
+        for probe_val in {float(t.min), float(t.max)} - {float(t.default)}:
+            if isinstance(t.default, int):
+                probe_val = int(round(probe_val))
+            set_by_path(root, path, probe_val)
+            probe = _build_only(module, eval_seed)
+            set_by_path(root, path, t.default)
+            if not isinstance(probe, StandardWorkflow) or \
+                    getattr(probe, "step", None) is None or \
+                    _static_signature(probe) != base_sig:
+                raise NotVmappable(f"Tune {path!r} changes workflow "
+                                   f"structure, not just hyperparams")
+
+    # the base individual's device-initialized step carries the shared
+    # params/dataset every individual trains from
+    prng.seed_all(eval_seed)
+    base.initialize(device=launcher.device or AutoDevice())
+    step = base.step
+    loader = base.loader
+    from znicz_tpu.parallel.step import full_batch_arrays
+    data_arr, labels_arr, why = full_batch_arrays(
+        loader, mse=base.loss_function == "mse")
+    if data_arr is None:
+        raise NotVmappable(why)
+    n_train = int(loader.class_lengths[TRAIN])
+    n_valid = int(loader.class_lengths[VALID])
+    mb = int(loader.minibatch_data.shape[0])
+    n_steps = n_train // mb
+    if n_valid == 0 or n_steps == 0:
+        raise NotVmappable("need a VALID split and >= 1 train minibatch")
+
+    data = np.asarray(data_arr.mem, np.float32)
+    labels = np.asarray(labels_arr.mem)
+    tr0, va0 = loader.class_offset(TRAIN), loader.class_offset(VALID)
+    xs = jnp.asarray(data[tr0:tr0 + n_steps * mb].reshape(
+        (n_steps, mb) + data.shape[1:]))
+    ys = jnp.asarray(labels[tr0:tr0 + n_steps * mb].reshape(
+        (n_steps, mb) + labels.shape[1:]))
+    ms = jnp.ones((n_steps, mb), bool)
+    # validation as one padded batch (pad rows masked out)
+    n_dev = int(np.prod(list(step.mesh.shape.values())))
+    pad = (-n_valid) % max(n_dev, 1)
+    vx = np.zeros((n_valid + pad,) + data.shape[1:], np.float32)
+    vx[:n_valid] = data[va0:va0 + n_valid]
+    vy = np.zeros((n_valid + pad,) + labels.shape[1:], labels.dtype)
+    vy[:n_valid] = labels[va0:va0 + n_valid]
+    vm = np.arange(n_valid + pad) < n_valid
+    vx, vy, vm = jnp.asarray(vx), jnp.asarray(vy), jnp.asarray(vm)
+
+    # the fused step's metric keys: softmax publishes "n_err", MSE
+    # publishes the batch SUM "mse_sum" — both lower-is-better fitnesses
+    metric = "mse_sum" if base.loss_function == "mse" else "n_err"
+    epochs = max(1, int(getattr(base.decision, "max_epochs", 1) or 1))
+    evaluator = make_population_evaluator(step, metric=metric,
+                                          epochs=epochs)
+    log.info(f"--optimize: vmapped population evaluator engaged "
+             f"({epochs} epochs x {n_steps} steps x {mb}, "
+             f"{n_valid} valid samples, metric {metric})")
+
+    def evaluate_many(pop):
+        hypers = []
+        for ind in pop:
+            for path, value in ind.items():
+                set_by_path(root, path, value)
+            w_i = _build_only(module, eval_seed)
+            if _static_signature(w_i) != base_sig:
+                raise RuntimeError(
+                    f"workflow structure drifted during optimization "
+                    f"(individual {ind}) — Tune probe missed a "
+                    f"structural dependency")
+            hypers.append(w_i.step.hyper_params())
+        hyper_pop = jax.tree.map(
+            lambda *leaves: jnp.asarray(np.stack(
+                [np.float32(v) for v in leaves])), *hypers)
+        fits = evaluator(hyper_pop, xs, ys, ms, vx, vy, vm)
+        return [float(f) for f in np.asarray(jax.device_get(fits))]
+
+    return evaluate_many
+
+
 def optimize(module, launcher, generations: int,
              population_size: int = 8) -> dict:
     """CLI ``--optimize`` path: GA over the Tune leaves currently in
-    ``root``; each evaluation is a full run of the workflow module with
-    the individual's values written into the tree."""
+    ``root``.  Fused-compatible workflows score whole generations in one
+    vmapped dispatch (the population as a batched axis); anything else
+    falls back to sequential full training runs per individual."""
 
     # ONE fixed evaluation seed, captured before any evaluation runs:
     # every individual then trains on identical data/init, so fitness
     # values are comparable (the old per-call re-derivation drifted the
     # seed between evaluations AND restarted the GA's own stream)
     eval_seed = prng.get("genetics").initial_seed & 0xFFFF
+    log = Logger()
+    tunes = dict(walk_tunes(root))
+    try:
+        evaluate_many = _try_vmapped_evaluator(module, launcher, eval_seed,
+                                               tunes, log)
+        mode = "vmapped"
+    except NotVmappable as exc:
+        log.info(f"--optimize: sequential evaluation ({exc})")
+        evaluate_many = None
+        mode = "sequential"
 
     def evaluate(individual: dict) -> float:
         for path, value in individual.items():
@@ -200,7 +388,9 @@ def optimize(module, launcher, generations: int,
         metric = holder["w"].decision.best_metric
         return float("inf") if metric is None else float(metric)
 
-    ga = Genetics(evaluate, population_size=population_size)
+    ga = Genetics(evaluate, population_size=population_size,
+                  evaluate_many=evaluate_many, tunes=tunes)
     best, fit = ga.run(generations)
     best["_fitness"] = fit
+    best["_evaluator"] = mode
     return best
