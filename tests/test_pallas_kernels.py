@@ -581,6 +581,7 @@ def test_pallas_hw_parity_sweep_interpret():
     res = run_parity(interpret=True)
     assert set(res) == {"sgd", "adam", "dropout", "lrn", "conv_fwd",
                         "conv_bwd", "deconv", "stochastic_pool",
-                        "kohonen", "flash_attention"}
+                        "kohonen", "flash_attention",
+                        "conv_fwd_bf16", "flash_attention_bf16"}
     bad = {k: v for k, v in res.items() if v != "ok"}
     assert not bad, bad

@@ -97,16 +97,20 @@ def run_parity(interpret: bool = False) -> dict:
         np.testing.assert_allclose(np.asarray(e_pl), e_ref, rtol=1e-3,
                                    atol=1e-4)
 
-    def conv_fwd():
-        x = jnp.asarray(rng.normal(size=(8, 16, 16, 64)), jnp.float32)
-        w = jnp.asarray(rng.normal(size=(3, 3, 64, 128)) * 0.1,
-                        jnp.float32)
-        b = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+    def conv_fwd(dtype=None, rtol=1e-4, atol=1e-4):
+        # one body serves both precisions: the policy feeds bf16
+        # activations to the kernels on hardware, and the compiled sweep
+        # must prove that lowering too
+        dtype = dtype or jnp.float32
+        x = jnp.asarray(rng.normal(size=(8, 16, 16, 64)), dtype)
+        w = jnp.asarray(rng.normal(size=(3, 3, 64, 128)) * 0.1, dtype)
+        b = jnp.asarray(rng.normal(size=(128,)), dtype)
         ref = conv_ops.forward_linear(jnp, x, w, b, (1, 1), (1, 1, 1, 1))
         out = pk.conv2d_im2col(x, w, b, (1, 1), (1, 1, 1, 1),
                                interpret=interpret)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=rtol, atol=atol)
 
     def conv_bwd():
         from znicz_tpu.ops.activations import LINEAR
@@ -172,17 +176,23 @@ def run_parity(interpret: bool = False) -> dict:
                                    atol=1e-4)
         np.testing.assert_array_equal(np.asarray(idx_pl), idx_ref)
 
-    def flash_attention():
+    def flash_attention(dtype=None, rtol=2e-4, atol=2e-4,
+                        grad_rtol=2e-3, grad_atol=2e-3):
+        # one body serves both precisions, forward AND backward — the
+        # bf16 backward (ds/dq emitted in q.dtype, bf16 MXU operands) is
+        # what production training runs and must prove its own lowering
+        dtype = dtype or jnp.float32
         b, t, h, dh = 2, 512, 2, 128
-        q = jnp.asarray(rng.normal(size=(b, t, h, dh)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(b, t, h, dh)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(b, t, h, dh)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(b, t, h, dh)), dtype)
+        k = jnp.asarray(rng.normal(size=(b, t, h, dh)), dtype)
+        v = jnp.asarray(rng.normal(size=(b, t, h, dh)), dtype)
         for causal in (False, True):
             o_ref = att.attention(jnp, q, k, v, causal=causal)
             o_pl = pk.flash_attention(q, k, v, causal=causal,
                                       interpret=interpret)
-            np.testing.assert_allclose(np.asarray(o_pl), np.asarray(o_ref),
-                                       rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(o_pl, np.float32),
+                np.asarray(o_ref, np.float32), rtol=rtol, atol=atol)
 
         def oracle(q, k, v):
             return att.attention(jnp, q, k, v, causal=True).sum()
@@ -194,14 +204,24 @@ def run_parity(interpret: bool = False) -> dict:
         g_ref = jax.grad(oracle, argnums=(0, 1, 2))(q, k, v)
         g_pl = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
         for a, b_ in zip(g_pl, g_ref):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
-                                       rtol=2e-3, atol=2e-3)
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b_, np.float32),
+                rtol=grad_rtol, atol=grad_atol)
+
+    def conv_fwd_bf16():
+        conv_fwd(dtype=jnp.bfloat16, rtol=5e-2, atol=5e-1)
+
+    def flash_attention_bf16():
+        flash_attention(dtype=jnp.bfloat16, rtol=5e-2, atol=5e-2,
+                        grad_rtol=1e-1, grad_atol=5e-1)
 
     for name, fn in (("sgd", sgd), ("adam", adam), ("dropout", dropout),
                      ("lrn", lrn), ("conv_fwd", conv_fwd),
                      ("conv_bwd", conv_bwd), ("deconv", deconv),
                      ("stochastic_pool", stochastic_pool),
                      ("kohonen", kohonen),
-                     ("flash_attention", flash_attention)):
+                     ("flash_attention", flash_attention),
+                     ("conv_fwd_bf16", conv_fwd_bf16),
+                     ("flash_attention_bf16", flash_attention_bf16)):
         _check(name, fn, results)
     return results
