@@ -82,3 +82,173 @@ def test_loader_uses_native_gather():
     b = serve(True)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
+
+
+# -- native inference runtime (libVeles/libZnicz rebuild) --------------------
+
+def _export_trained(build, tmp_path, name, **kw):
+    import os
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.utils.export import export_forward
+
+    prng.seed_all(7)
+    w = build(**kw)
+    w.initialize(device=TPUDevice())
+    w.run()
+    return export_forward(w, os.path.join(str(tmp_path), name))
+
+
+def test_native_infer_fc_matches_python(tmp_path):
+    """The C++ runtime loads a forward package standalone (ZIP + NPY +
+    manifest all parsed natively) and reproduces the Python
+    ExportedForward on an FC+softmax model."""
+    from znicz_tpu.models import wine
+    from znicz_tpu.native.infer import NativeForward, available
+    from znicz_tpu.utils.export import ExportedForward
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    path = _export_trained(wine.build, tmp_path, "wine.npz", max_epochs=2,
+                           n_train=60, n_valid=30, minibatch_size=10)
+    py = ExportedForward(path)
+    cc = NativeForward(path)
+    x = np.random.default_rng(0).normal(size=(16, 13)).astype(np.float32)
+    np.testing.assert_allclose(cc(x), np.asarray(py(x)).reshape(16, -1),
+                               rtol=2e-4, atol=2e-5)
+    # softmax rows normalize
+    np.testing.assert_allclose(cc(x).sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_native_infer_conv_stack_matches_python(tmp_path):
+    """conv_relu -> max_pooling (default window stride) -> conv_relu ->
+    max_pooling -> all2all_relu -> softmax, end to end vs Python."""
+    from znicz_tpu.models import mnist_conv
+    from znicz_tpu.native.infer import NativeForward, available
+    from znicz_tpu.utils.export import ExportedForward
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    path = _export_trained(mnist_conv.build, tmp_path, "conv.npz",
+                           max_epochs=1, n_train=200, n_valid=50,
+                           minibatch_size=50)
+    py = ExportedForward(path)
+    cc = NativeForward(path)
+    x = np.random.default_rng(1).normal(
+        size=(8,) + py.input_shape).astype(np.float32)
+    np.testing.assert_allclose(cc(x), np.asarray(py(x)).reshape(8, -1),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_native_infer_rejects_unsupported_layer(tmp_path):
+    """A package with a layer outside the v1 forward set fails to LOAD
+    with the type named — never a silent wrong answer."""
+    import json
+    import os
+
+    from znicz_tpu.native.infer import NativeForward, available
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    meta = {"format": "znicz_tpu.forward", "version": 1, "name": "bad",
+            "ema": False, "input_shape": [4, 4, 2],
+            "arch": [{"type": "deconv", "config": {"n_kernels": 2,
+                                                   "kx": 3, "ky": 3}}]}
+    path = os.path.join(str(tmp_path), "bad.npz")
+    with open(path, "wb") as f:
+        np.savez_compressed(f, __arch__=np.array(json.dumps(meta)))
+    with pytest.raises(ValueError, match="deconv"):
+        NativeForward(path)
+
+
+def _raw_pkg(tmp_path, name, arch, arrays, input_shape=(4, 4, 2)):
+    import json
+    import os
+
+    meta = {"format": "znicz_tpu.forward", "version": 1, "name": "t",
+            "ema": False, "input_shape": list(input_shape), "arch": arch}
+    path = os.path.join(str(tmp_path), name)
+    with open(path, "wb") as f:
+        np.savez_compressed(f, __arch__=np.array(json.dumps(meta)),
+                            **arrays)
+    return path
+
+
+def test_native_infer_pooling_default_geometry(tmp_path):
+    """A bare {"type": "max_pooling"} config means kx=ky=2 with stride =
+    window (the Pooling units' Python defaults) — must load and match the
+    oracle, not divide by zero."""
+    from znicz_tpu.native.infer import NativeForward, available
+    from znicz_tpu.ops import pooling as pool_ops
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    p = _raw_pkg(tmp_path, "pool.npz",
+                 [{"type": "max_pooling", "config": {}}], {}, (5, 5, 3))
+    x = np.random.default_rng(3).normal(size=(2, 5, 5, 3)).astype(
+        np.float32)
+    ref, _ = pool_ops.max_forward(np, x, 2, 2, 2, 2)
+    np.testing.assert_allclose(NativeForward(p)(x), ref.reshape(2, -1),
+                               rtol=1e-6)
+
+
+def test_native_infer_weights_transposed(tmp_path):
+    """weights_transposed fc layers (stored (out, in), applied as W.T —
+    All2All.xla_apply_linear) are honored by a load-time transpose."""
+    from znicz_tpu.native.infer import NativeForward, available
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    rng = np.random.default_rng(4)
+    w_t = rng.normal(size=(6, 32)).astype(np.float32)   # (out, in)
+    p = _raw_pkg(tmp_path, "wt.npz",
+                 [{"type": "all2all",
+                   "config": {"output_sample_shape": 6,
+                              "weights_transposed": True}}],
+                 {"0.weights": w_t}, (4, 4, 2))
+    x = rng.normal(size=(3, 4, 4, 2)).astype(np.float32)
+    ref = x.reshape(3, -1) @ w_t.T
+    np.testing.assert_allclose(NativeForward(p)(x), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_native_infer_malformed_packages_fail_closed(tmp_path):
+    """Structurally broken packages fail at LOAD with a named reason —
+    never UB, never a silent wrong answer."""
+    from znicz_tpu.native.infer import NativeForward, available
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    cases = [
+        # fc without weights
+        ([{"type": "all2all", "config": {"output_sample_shape": 4}}], {}),
+        # arch entry without a type key
+        ([{"config": {}}], {}),
+        # conv weights disagreeing with declared geometry
+        ([{"type": "conv", "config": {"n_kernels": 4, "kx": 3, "ky": 3}}],
+         {"0.weights": np.zeros((5, 5, 2, 4), np.float32)}),
+        # fc weight rows != input features
+        ([{"type": "all2all", "config": {"output_sample_shape": 4}}],
+         {"0.weights": np.zeros((7, 4), np.float32)}),
+    ]
+    for i, (arch, arrays) in enumerate(cases):
+        p = _raw_pkg(tmp_path, f"bad{i}.npz", arch, arrays)
+        with pytest.raises(ValueError):
+            NativeForward(p)
+
+
+def test_native_infer_closed_handle_raises(tmp_path):
+    from znicz_tpu.native.infer import NativeForward, available
+
+    if not available():
+        pytest.skip("no native compiler/zlib")
+    rng = np.random.default_rng(5)
+    p = _raw_pkg(tmp_path, "ok.npz",
+                 [{"type": "all2all", "config": {"output_sample_shape": 3}}],
+                 {"0.weights": rng.normal(size=(32, 3)).astype(np.float32)})
+    nf = NativeForward(p)
+    nf(np.zeros((1, 4, 4, 2), np.float32))
+    nf.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        nf(np.zeros((1, 4, 4, 2), np.float32))

@@ -27,21 +27,35 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> Optional[ctypes.CDLL]:
-    with open(_SRC, "rb") as f:
+def build_extension(src: str, extra_flags: tuple = (),
+                    timeout: int = 180) -> Optional[str]:
+    """Compile ``src`` to a cached .so (keyed by source hash under
+    ``root.common.dirs.cache``); returns the .so path or None when no
+    compiler is available.  The ONE compile-and-cache implementation —
+    shared by every native module in this package."""
+    with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    stem = os.path.splitext(os.path.basename(src))[0]
     cache_dir = str(root.common.dirs.cache)
     os.makedirs(cache_dir, exist_ok=True)
-    so_path = os.path.join(cache_dir, f"loader_core_{digest}.so")
+    so_path = os.path.join(cache_dir, f"{stem}_{digest}.so")
     if not os.path.exists(so_path):
         tmp = so_path + f".tmp{os.getpid()}"
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-               _SRC, "-o", tmp]
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
+               "-o", tmp, *extra_flags]
         try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=timeout)
         except (OSError, subprocess.SubprocessError):
             return None
         os.replace(tmp, so_path)
+    return so_path
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    so_path = build_extension(_SRC, extra_flags=("-pthread",), timeout=120)
+    if so_path is None:
+        return None
     lib = ctypes.CDLL(so_path)
     lib.xorshift128p_fill.argtypes = [
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_float),

@@ -1,0 +1,111 @@
+"""ctypes binding for the native inference runtime (infer_core.cpp —
+the libVeles/libZnicz rebuild, SURVEY.md §3.2/§4.5).
+
+``NativeForward(path)`` loads a utils/export.py forward package entirely
+in C++ (ZIP + NPY + manifest parsing, f32 op set) and serves
+``__call__(x) -> np.ndarray`` like the Python ``ExportedForward`` — but
+with no Python/JAX in the serving path after load.  ``available()``
+gates call sites (compiler or zlib may be absent)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.native import build_extension
+
+_SRC = os.path.join(os.path.dirname(__file__), "infer_core.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    so_path = build_extension(_SRC, extra_flags=("-lz",))
+    if so_path is None:
+        return None
+    lib = ctypes.CDLL(so_path)
+    lib.znicz_infer_load.argtypes = [ctypes.c_char_p]
+    lib.znicz_infer_load.restype = ctypes.c_void_p
+    lib.znicz_infer_error.argtypes = [ctypes.c_void_p]
+    lib.znicz_infer_error.restype = ctypes.c_char_p
+    lib.znicz_infer_input_rank.argtypes = [ctypes.c_void_p]
+    lib.znicz_infer_input_rank.restype = ctypes.c_int
+    lib.znicz_infer_input_shape.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.znicz_infer_output_numel.argtypes = [ctypes.c_void_p]
+    lib.znicz_infer_output_numel.restype = ctypes.c_int64
+    lib.znicz_infer_run.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.znicz_infer_run.restype = ctypes.c_int
+    lib.znicz_infer_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _build()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+class NativeForward:
+    """A forward package served by the C++ runtime."""
+
+    def __init__(self, path: str) -> None:
+        L = lib()
+        if L is None:
+            raise RuntimeError("native inference runtime unavailable "
+                               "(no compiler or zlib)")
+        self._lib = L
+        self._h = L.znicz_infer_load(os.fsencode(path))
+        if not self._h:
+            raise ValueError(
+                f"cannot load {path!r}: "
+                f"{L.znicz_infer_error(None).decode()}")
+        rank = L.znicz_infer_input_rank(self._h)
+        shape = (ctypes.c_int64 * rank)()
+        L.znicz_infer_input_shape(self._h, shape)
+        self.input_shape = tuple(int(d) for d in shape)
+        self.output_numel = int(L.znicz_infer_output_numel(self._h))
+
+    def __call__(self, x) -> np.ndarray:
+        if not self._h:
+            raise RuntimeError("NativeForward is closed")
+        x = np.ascontiguousarray(x, np.float32)
+        if x.shape[1:] != self.input_shape:
+            raise ValueError(f"input shape {x.shape[1:]} != package "
+                             f"input {self.input_shape}")
+        batch = x.shape[0]
+        out = np.empty(batch * self.output_numel, np.float32)
+        rc = self._lib.znicz_infer_run(
+            self._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(batch),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(
+                self._lib.znicz_infer_error(self._h).decode())
+        return out.reshape(batch, -1)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.znicz_infer_free(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105 — best-effort native cleanup
+        try:
+            self.close()
+        except Exception:  # pragma: no cover
+            pass
