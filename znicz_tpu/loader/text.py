@@ -110,6 +110,29 @@ def synthesize_text_corpus(directory: str, n_train: int = 600,
         f.write(SYNTH_VERSION)
 
 
+def ensure_corpus_files(data_dir: str, synthesize: bool, log=None) -> None:
+    """The ONE corpus ensure/staleness protocol (sibling-loader
+    convention, see MnistLoader._ensure_files): all files required — a
+    torn synthesis shows up as a missing file and regenerates instead of
+    silently serving an empty split; a stale ``.synth_version`` rebuilds.
+    Shared by the bag-of-words and char-sequence loaders."""
+    missing = [n for n in FILES.values()
+               if not os.path.exists(os.path.join(data_dir, n))]
+    vfile = os.path.join(data_dir, ".synth_version")
+    stale = False
+    if os.path.exists(vfile):
+        with open(vfile) as f:
+            stale = f.read().strip() != SYNTH_VERSION
+    if not missing and not stale:
+        return
+    if not synthesize:
+        raise FileNotFoundError(
+            f"corpus files missing in {data_dir}: {missing}")
+    if log is not None:
+        log(f"synthesizing text corpus in {data_dir}")
+    synthesize_text_corpus(data_dir)
+
+
 @register_loader("text_bow")
 class TextBagOfWordsLoader(NormalizerStateMixin, FullBatchLoader):
     """Bag-of-words corpus loader.
@@ -139,24 +162,7 @@ class TextBagOfWordsLoader(NormalizerStateMixin, FullBatchLoader):
         return 2
 
     def _ensure_files(self) -> None:
-        # all corpus files required (sibling-loader convention, see
-        # MnistLoader._ensure_files): a torn synthesis shows up as a
-        # missing file and regenerates instead of silently serving an
-        # empty VALID split
-        missing = [n for n in FILES.values()
-                   if not os.path.exists(os.path.join(self.data_dir, n))]
-        vfile = os.path.join(self.data_dir, ".synth_version")
-        stale = False
-        if os.path.exists(vfile):
-            with open(vfile) as f:
-                stale = f.read().strip() != SYNTH_VERSION
-        if not missing and not stale:
-            return
-        if not self.synthesize:
-            raise FileNotFoundError(
-                f"corpus files missing in {self.data_dir}: {missing}")
-        self.info(f"synthesizing text corpus in {self.data_dir}")
-        synthesize_text_corpus(self.data_dir)
+        ensure_corpus_files(self.data_dir, self.synthesize, self.info)
 
     def _load_raw(self):
         """(test_docs, test_y, train_docs, train_y) straight from the

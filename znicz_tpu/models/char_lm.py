@@ -1,0 +1,52 @@
+"""Character-level language model workflow (beyond-parity sample: the
+reference predates transformers — this ties the SPMD transformer stack
+into the ``run(load, main)`` zoo contract).
+
+Control graph (the Kohonen-demo shape): Repeater -> CharSequenceLoader
+-> TransformerLMStep -> DecisionMSE -> Repeater.  The decision watches
+mean validation cross-entropy per token; training stops on max_epochs or
+stagnation like every other sample.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.core.plumbing import Repeater
+from znicz_tpu.loader.sequence import CharSequenceLoader
+from znicz_tpu.units.decision import DecisionMSE
+from znicz_tpu.units.lm import TransformerLMStep
+from znicz_tpu.units.nn_units import NNWorkflow
+
+
+def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
+          n_layers: int = 2, d: int = 32, heads: int = 2, lr: float = 0.05,
+          valid_fraction: float = 0.1, mesh=None,
+          data_dir: str = "") -> NNWorkflow:
+    w = NNWorkflow(name="CharLM")
+    w.repeater = Repeater(w)
+    w.loader = CharSequenceLoader(
+        w, data_dir=data_dir, seq_len=seq_len,
+        minibatch_size=minibatch_size, valid_fraction=valid_fraction)
+    step = w.step = TransformerLMStep(
+        w, loader=w.loader, n_layers=n_layers, d=d, heads=heads, lr=lr,
+        mesh=mesh)
+    dec = w.decision = DecisionMSE(w, max_epochs=max_epochs)
+    w.forwards = [step]      # snapshot inventory slot (params live here)
+    w.gds = []
+
+    w.repeater.link_from(w.start_point)
+    w.loader.link_from(w.repeater)
+    step.link_from(w.loader)
+    dec.link_from(step)
+    w.repeater.link_from(dec)
+    w.end_point.link_from(dec)
+    w.end_point.gate_block = ~dec.complete
+
+    dec.link_attrs(w.loader, "minibatch_class", "last_minibatch",
+                   "class_lengths", "epoch_number")
+    dec.link_attrs(step, "minibatch_mse", "minibatch_size")
+    return w
+
+
+def run(load, main):
+    load(build)
+    main()

@@ -145,10 +145,64 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
     return x
 
 
+def _check_tp(mesh: Mesh, heads: int, d: int, ff: int) -> int:
+    tp_size = mesh.shape["model"]
+    if heads % tp_size or d % tp_size or ff % tp_size:
+        raise ValueError(f"tp={tp_size} must divide heads={heads}, "
+                         f"d={d} and ff={ff}")
+    return heads // tp_size
+
+
+def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
+                interp, cdt):
+    """The ONE forward + CE-loss body (shared by the train step's loss_fn
+    and the eval pass, so their numerics can never drift).  ``mask`` is a
+    per-row validity mask or None; masked rows (the loader's padded tail)
+    contribute neither loss nor — through AD — gradients, the framework's
+    padding contract (loader/base.py)."""
+    ps = jax.tree.map(lambda w: w.astype(cdt), ps)
+    x = ps["emb"][tokens]                         # (b_l, t_l, d)
+    for p in ps["blocks"]:
+        x = _block(x, p, heads_local, causal, use_flash, interp)
+    logits = (x @ ps["head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is None:
+        # psum makes AD emit globally-reduced grads for replicated
+        # params; model-sharded params get their local shard's grad
+        return lax.psum(-picked.mean(), ("data", "seq"))
+    # masked variant, SAME n_shards-scaled convention as the unmasked
+    # psum-of-local-means (the caller divides loss and grads by n_shards)
+    m = jnp.broadcast_to(mask[:, None].astype(jnp.float32), picked.shape)
+    n_seq = lax.psum(1, "seq")
+    n_shards = lax.psum(1, "data") * n_seq
+    # the mask is seq-INVARIANT (each seq shard sees the same rows), so
+    # its token count reduces over "data" and multiplies by n_seq — a
+    # joint psum would mix varying and invarying axis states
+    total = lax.psum(m.sum(), "data") * n_seq
+    return n_shards * lax.psum(-(picked * m).sum(), ("data", "seq")) / \
+        jnp.maximum(total, 1.0)
+
+
+def _shardmap_kwargs(use_flash: bool, interp: bool) -> dict:
+    """The Pallas-HLO-interpreter vma workaround (see make_train_step's
+    long note): relax shard_map's replication checker only for
+    interpret-mode flash, under whichever spelling this jax has."""
+    if not (use_flash and interp):
+        return {}
+    import inspect
+    flag = "check_vma" if "check_vma" in \
+        inspect.signature(shard_map).parameters else "check_rep"
+    return {flag: False}
+
+
 def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
-                    compute_dtype=None, shard_update: bool = False):
-    """-> jitted ``step(params, tokens, labels) -> (params, loss)``.
+                    compute_dtype=None, shard_update: bool = False,
+                    masked: bool = False):
+    """-> jitted ``step(params, tokens, labels) -> (params, loss)``
+    (``masked=True``: ``step(params, tokens, labels, mask)`` with a
+    per-row bool mask — padded loader rows train nothing).
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -169,11 +223,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     ZeRO-1 memory win is real) must match.  Tensor-sharded leaves
     already live partitioned and update locally.
     """
-    tp_size = mesh.shape["model"]
-    if heads % tp_size or d % tp_size or ff % tp_size:
-        raise ValueError(f"tp={tp_size} must divide heads={heads}, "
-                         f"d={d} and ff={ff}")
-    heads_local = heads // tp_size
+    heads_local = _check_tp(mesh, heads, d, ff)
     specs = param_specs(n_layers)
     cdt = _default_compute_dtype(compute_dtype)
     from znicz_tpu.core.config import root as root_cfg
@@ -191,19 +241,10 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
             lr * zero.pad_slice(g, rank, n_data) / scale
         return zero.psum_regather(new_sh, rank, n_data, "data", w)
 
-    def local_step(params, tokens, labels):
+    def local_step(params, tokens, labels, mask=None):
         def loss_fn(ps):
-            ps = jax.tree.map(lambda w: w.astype(cdt), ps)
-            x = ps["emb"][tokens]                     # (b_l, t_l, d)
-            for p in ps["blocks"]:
-                x = _block(x, p, heads_local, causal, use_flash, interp)
-            logits = (x @ ps["head"]).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            picked = jnp.take_along_axis(
-                logp, labels[..., None], axis=-1)[..., 0]
-            # psum makes AD emit globally-reduced grads for replicated
-            # params; model-sharded params get their local shard's grad
-            return lax.psum(-picked.mean(), ("data", "seq"))
+            return _forward_ce(ps, tokens, labels, mask, heads_local,
+                               causal, use_flash, interp, cdt)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
@@ -224,24 +265,46 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                 lambda w, g: w - lr * g / n_shards, params, grads)
         return new_params, loss / n_shards
 
-    kwargs = {}
-    if use_flash and interp:
-        # the Pallas HLO interpreter's internal dynamic_slices mix vma'd
-        # and unvaried operands, tripping shard_map's vma checker — a
-        # JAX-internal limitation of interpret mode only; the Mosaic
-        # path (real TPU) type-checks fine, so keep checking there.
-        # _flash_eligible only allows interpret-flash on a SINGLETON
-        # mesh, where the relaxed psum transposition is exact.  Older
-        # jax's fallback shard_map spells the flag check_rep
-        import inspect
-        flag = "check_vma" if "check_vma" in \
-            inspect.signature(shard_map).parameters else "check_rep"
-        kwargs[flag] = False
+    # _shardmap_kwargs: the Pallas HLO interpreter's internal
+    # dynamic_slices mix vma'd and unvaried operands, tripping shard_map's
+    # vma checker — a JAX-internal limitation of interpret mode only; the
+    # Mosaic path (real TPU) type-checks fine, so keep checking there.
+    # _flash_eligible only allows interpret-flash on a SINGLETON mesh,
+    # where the relaxed psum transposition is exact.
+    batch_spec = P("data", "seq")
+    in_specs = (specs, batch_spec, batch_spec) + \
+        ((P("data"),) if masked else ())
     step = shard_map(
-        local_step, mesh=mesh,
-        in_specs=(specs, P("data", "seq"), P("data", "seq")),
-        out_specs=(specs, P()), **kwargs)
+        local_step, mesh=mesh, in_specs=in_specs,
+        out_specs=(specs, P()), **_shardmap_kwargs(use_flash, interp))
     return jax.jit(step), specs
+
+
+def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
+                   vocab: int, causal: bool = True, compute_dtype=None,
+                   masked: bool = False):
+    """-> jitted ``eval_loss(params, tokens, labels[, mask]) -> loss`` —
+    the train step's forward + CE loss (the SHARED ``_forward_ce`` body,
+    so the numerics cannot drift) with no update: validation/test
+    passes."""
+    heads_local = _check_tp(mesh, heads, d, ff)
+    specs = param_specs(n_layers)
+    cdt = _default_compute_dtype(compute_dtype)
+    from znicz_tpu.core.config import root as root_cfg
+    interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
+    use_flash = _flash_eligible(mesh, interp)
+
+    def local_eval(params, tokens, labels, mask=None):
+        n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
+        return _forward_ce(params, tokens, labels, mask, heads_local,
+                           causal, use_flash, interp, cdt) / n_shards
+
+    batch_spec = P("data", "seq")
+    in_specs = (specs, batch_spec, batch_spec) + \
+        ((P("data"),) if masked else ())
+    fn = shard_map(local_eval, mesh=mesh, in_specs=in_specs,
+                   out_specs=P(), **_shardmap_kwargs(use_flash, interp))
+    return jax.jit(fn)
 
 
 # -- dp x pipe x expert configuration ---------------------------------------

@@ -1,0 +1,153 @@
+"""Transformer language-model step unit — wires the SPMD transformer
+stack (znicz_tpu.parallel.transformer: sharded blocks, ring/flash
+attention, mixed precision) into the unit graph with the same control
+contract as FusedTrainStep: Repeater -> Loader -> step -> Decision.
+
+Beyond-parity: the reference predates transformers (SURVEY.md §3.4 row
+"SP/CP: NO — pre-transformer framework"); this unit is what makes the
+beyond-parity stack a *workflow citizen* — epochs, validation passes,
+Decision stopping, snapshot/resume — instead of a standalone demo.
+
+XLA-only by design (like ``optimizer="adam"`` is fused-only): a numpy
+transformer oracle would re-implement the whole stack for no oracle
+value — parity for the math is pinned in test_transformer_spmd.py
+against autograd.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.loader.base import TRAIN
+
+
+class TransformerLMStep(AcceleratedUnit):
+    """One train-or-eval step per served (tokens, labels) minibatch.
+
+    Publishes ``minibatch_mse`` (mean CE loss per token — the DecisionMSE
+    contract: a lower-is-better per-sample metric) and mirrors the fused
+    step's donation/dispatch discipline: params live on device, the loss
+    read is the only d2h sync per minibatch.
+    """
+
+    def __init__(self, workflow=None, loader=None, n_layers: int = 2,
+                 d: int = 32, heads: int = 2, ff: Optional[int] = None,
+                 lr: float = 0.1, mesh=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.loader = loader
+        self.n_layers = int(n_layers)
+        self.d = int(d)
+        self.heads = int(heads)
+        self.ff = int(ff) if ff is not None else 4 * self.d
+        self.lr = float(lr)
+        self.mesh = mesh
+        self.vocab_size: Optional[int] = None
+        # decision links (DecisionMSE contract)
+        self.minibatch_mse = 0.0
+        self.minibatch_size = 0
+        self._params = None
+        self._step = None
+        self._eval = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def numpy_init(self) -> None:
+        raise NotImplementedError(
+            "TransformerLMStep is XLA-only (run with -d tpu/auto); the "
+            "transformer stack has no numpy oracle by design")
+
+    def xla_init(self) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from znicz_tpu.parallel import transformer as tfm
+        from znicz_tpu.parallel.mesh import make_mesh
+
+        if self.loader is None:
+            raise ValueError("TransformerLMStep needs loader=")
+        self.vocab_size = int(self.loader.vocab_size)
+        if self.mesh is None:
+            self.mesh = make_mesh({"data": 1, "seq": 1, "model": 1})
+        if self._params is None:
+            self._params = tfm.init_params(
+                prng.get(), self.n_layers, self.d, self.heads, self.ff,
+                self.vocab_size)
+        specs = tfm.param_specs(self.n_layers)
+        self._params = jax.device_put(
+            self._params, jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P)))
+        # masked=True: the loader's padded tail rows (base.py static-shape
+        # policy) contribute neither loss nor gradients
+        self._step, _ = tfm.make_train_step(
+            self.mesh, self.n_layers, self.d, self.heads, self.ff,
+            self.vocab_size, lr=self.lr, masked=True)
+        self._eval = tfm.make_eval_loss(
+            self.mesh, self.n_layers, self.d, self.heads, self.ff,
+            self.vocab_size, masked=True)
+        #: minibatch placement: batch over data, time over seq
+        self._batch_sharding = NamedSharding(self.mesh, P("data", "seq"))
+        self._mask_sharding = NamedSharding(self.mesh, P("data"))
+
+    # -- compute ------------------------------------------------------------
+    def numpy_run(self) -> None:
+        self.numpy_init()
+
+    def xla_run(self) -> None:
+        import jax
+
+        self.loader.minibatch_data.unmap()
+        self.loader.minibatch_labels.unmap()
+        tokens = jax.device_put(self.loader.minibatch_data.devmem,
+                                self._batch_sharding)
+        labels = jax.device_put(self.loader.minibatch_labels.devmem,
+                                self._batch_sharding)
+        count = int(self.loader.minibatch_size)
+        mask = jax.device_put(
+            np.arange(tokens.shape[0]) < count, self._mask_sharding)
+        if int(self.loader.minibatch_class) == TRAIN:
+            self._params, loss = self._step(self._params, tokens, labels,
+                                            mask)
+        else:
+            loss = self._eval(self._params, tokens, labels, mask)
+        self.minibatch_mse = float(jax.device_get(loss))
+        self.minibatch_size = count
+
+    # -- snapshot support ---------------------------------------------------
+    def state_dict(self) -> dict:
+        import jax
+
+        if self._params is None:
+            return {}
+        return {"params": jax.tree.map(
+            lambda a: np.asarray(jax.device_get(a)), self._params)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if "params" not in state:
+            return
+        params = state["params"]
+        restored_vocab = int(params["emb"].shape[0])
+        if self.vocab_size is not None and \
+                restored_vocab != self.vocab_size:
+            raise ValueError(
+                f"snapshot params carry vocab {restored_vocab} but the "
+                f"loader serves vocab {self.vocab_size} — restore the "
+                f"loader state first (CharSequenceLoader snapshots its "
+                f"vocab) or use the matching corpus")
+        if self._step is not None:
+            # already initialized: only re-place the arrays onto the
+            # mesh — the compiled step/eval stay valid (same shapes)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from znicz_tpu.parallel import transformer as tfm
+
+            specs = tfm.param_specs(self.n_layers)
+            params = jax.device_put(
+                params, jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s), specs,
+                    is_leaf=lambda x: isinstance(x, P)))
+        self._params = params
