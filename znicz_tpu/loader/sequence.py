@@ -123,10 +123,26 @@ class CharSequenceLoader(Loader):
         return {**super().state_dict(), "vocab": list(self.vocab)}
 
     def load_state_dict(self, state: dict) -> None:
-        super().load_state_dict(state)
+        # adopt the snapshot vocab BEFORE restoring the serving position:
+        # the restored shuffle orders index the snapshot-era window
+        # table, which re-vectorizing reproduces
         if "vocab" in state and list(state["vocab"]) != self.vocab:
             self.warning("corpus vocab differs from the snapshot's; "
                          "re-vectorizing with the snapshot vocab "
                          "(unknown chars map to id 0)")
             self.vocab = list(state["vocab"])
             self._vectorize()
+        super().load_state_dict(state)
+        # a corpus that changed SIZE since the snapshot shifts the window
+        # table and the class boundaries — restored indices would serve
+        # wrong-split (or out-of-range) windows; fail loudly instead
+        for cls, order in self._shuffled.items():
+            lo = self.class_offset(cls)
+            hi = lo + self.class_lengths[cls]
+            if len(order) != self.class_lengths[cls] or \
+                    (len(order) and (order.min() < lo or
+                                     order.max() >= hi)):
+                raise ValueError(
+                    "snapshot loader state does not match the current "
+                    "corpus geometry — cannot resume the serving "
+                    "position on a changed corpus")

@@ -19,8 +19,8 @@ from znicz_tpu.units.nn_units import NNWorkflow
 
 def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
           n_layers: int = 2, d: int = 32, heads: int = 2, lr: float = 0.05,
-          valid_fraction: float = 0.1, mesh=None,
-          data_dir: str = "") -> NNWorkflow:
+          valid_fraction: float = 0.1, mesh=None, data_dir: str = "",
+          snapshotter_config: dict | None = None) -> NNWorkflow:
     w = NNWorkflow(name="CharLM")
     w.repeater = Repeater(w)
     w.loader = CharSequenceLoader(
@@ -37,8 +37,16 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
     w.loader.link_from(w.repeater)
     step.link_from(w.loader)
     dec.link_from(step)
-    w.repeater.link_from(dec)
-    w.end_point.link_from(dec)
+    tail = dec
+    if snapshotter_config is not None:
+        from znicz_tpu.snapshotter import NNSnapshotter
+        snap = w.snapshotter = NNSnapshotter(w, **snapshotter_config)
+        snap.link_from(dec)
+        snap.link_workflow_state(w)
+        snap.gate_skip = ~dec.epoch_ended
+        tail = snap
+    w.repeater.link_from(tail)
+    w.end_point.link_from(tail)
     w.end_point.gate_block = ~dec.complete
 
     dec.link_attrs(w.loader, "minibatch_class", "last_minibatch",

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Optional
 
 import numpy as np
@@ -31,18 +32,78 @@ FORMAT_VERSION = 1
 
 
 # -- state collection -------------------------------------------------------
+def _flatten_state(prefix: str, obj, out: dict) -> None:
+    """Nested dict/list state -> flat npz keys (``.name`` for dict keys,
+    ``#i`` for list positions) — how state_dict-only units (e.g. the
+    transformer LM step's param pytree) ride the array snapshot."""
+    if isinstance(obj, dict):
+        for k in obj:
+            _flatten_state(f"{prefix}.{k}", obj[k], out)
+    elif isinstance(obj, (list, tuple)):
+        for j, v in enumerate(obj):
+            _flatten_state(f"{prefix}#{j}", v, out)
+    else:
+        out[prefix] = np.asarray(obj)
+
+
+_PATH_STEP = re.compile(r"([.#])([^.#]+)")
+
+
+def _unflatten_state(prefix: str, arrays: dict):
+    """Inverse of :func:`_flatten_state` for one unit's key prefix."""
+    root: dict = {}
+    for key, val in arrays.items():
+        if not key.startswith((prefix + ".", prefix + "#")):
+            continue
+        steps = _PATH_STEP.findall(key[len(prefix):])
+        node = root
+        for n, (sep, name) in enumerate(steps):
+            k = int(name) if sep == "#" else name
+            if n == len(steps) - 1:
+                node[k] = val
+            else:
+                node = node.setdefault(k, {})
+
+    def materialize(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(isinstance(k, int) for k in node):
+            return [materialize(node[i]) for i in sorted(node)]
+        return {k: materialize(v) for k, v in node.items()}
+
+    return materialize(root)
+
+
+def _state_only_units(workflow) -> dict:
+    """unit index -> unit, for forwards that snapshot through
+    state_dict/load_state_dict instead of weights/bias Arrays."""
+    out = {}
+    for i, fwd in enumerate(workflow.forwards):
+        has_arrays = any(getattr(fwd, a, None)
+                         for a in ("weights", "bias"))
+        if not has_arrays and hasattr(fwd, "state_dict") and \
+                hasattr(fwd, "load_state_dict"):
+            out[i] = fwd
+    return out
+
+
 def collect_state(workflow) -> tuple[dict, dict]:
     """-> (arrays, meta): every array the training state needs, plus
     JSON-able metadata.  Covers forwards' weights/bias, gds' momentum
-    buffers, loader position + shuffle order, decision counters, and all
-    PRNG streams."""
+    buffers, state_dict-only forwards (flattened pytrees), loader
+    position + shuffle order, decision counters, and all PRNG streams."""
     step = getattr(workflow, "step", None)
-    if step is not None and getattr(step, "_params", None) is not None:
+    if step is not None and getattr(step, "_params", None) is not None \
+            and hasattr(step, "sync_to_units"):
         step.sync_to_units()  # device params -> unit Arrays
     arrays: dict[str, np.ndarray] = {}
     # three-arg getattr: non-standard forwards (KohonenTrainer has no bias)
     # simply contribute fewer arrays
+    state_only = _state_only_units(workflow)
     for i, fwd in enumerate(workflow.forwards):
+        if i in state_only:
+            _flatten_state(f"unitstate.{i}", fwd.state_dict(), arrays)
+            continue
         for attr in ("weights", "bias"):
             arr = getattr(fwd, attr, None)
             if arr:
@@ -92,8 +153,11 @@ def restore_state(workflow, path: str) -> dict:
         arrays = {k: zf[k] for k in zf.files if k != "__meta__"}
     # strict key/shape matching: a snapshot from a different architecture
     # must fail loudly, never silently resume from partly-random weights
+    state_only = _state_only_units(workflow)
     targets: dict[str, object] = {}
     for i, fwd in enumerate(workflow.forwards):
+        if i in state_only:
+            continue
         for attr in ("weights", "bias"):
             if getattr(fwd, attr, None):
                 targets[f"forward.{i}.{attr}"] = getattr(fwd, attr)
@@ -102,12 +166,35 @@ def restore_state(workflow, path: str) -> dict:
             if getattr(gd, attr, None):
                 targets[f"gd.{i}.{attr}"] = getattr(gd, attr)
     param_keys = {k for k in arrays
-                  if not k.startswith(("loader.", "step."))}
+                  if not k.startswith(("loader.", "step.", "unitstate."))}
     if param_keys != set(targets):
         raise ValueError(
             f"snapshot/workflow architecture mismatch: snapshot-only keys "
             f"{sorted(param_keys - set(targets))}, workflow-only keys "
             f"{sorted(set(targets) - param_keys)}")
+    # ...and the same strictness for state_dict-only units: the pytree
+    # STRUCTURE (key set) must match the unit's current state; shape
+    # semantics are the unit's own load_state_dict contract (e.g. the LM
+    # validates d/blocks/vocab — the vocab dimension may legitimately
+    # track the restored loader rather than the fresh build)
+    snap_state_units = {int(k[len("unitstate."):].split(".")[0]
+                            .split("#")[0])
+                        for k in arrays if k.startswith("unitstate.")}
+    if snap_state_units != set(state_only):
+        raise ValueError(
+            f"snapshot/workflow architecture mismatch: snapshot carries "
+            f"unit state for {sorted(snap_state_units)}, workflow expects "
+            f"it for {sorted(state_only)}")
+    for i, fwd in state_only.items():
+        expected: dict = {}
+        _flatten_state(f"unitstate.{i}", fwd.state_dict(), expected)
+        got = {k for k in arrays
+               if k.startswith((f"unitstate.{i}.", f"unitstate.{i}#"))}
+        if got != set(expected):
+            raise ValueError(
+                f"snapshot/workflow architecture mismatch in unit {i} "
+                f"state: snapshot-only keys {sorted(got - set(expected))},"
+                f" workflow-only keys {sorted(set(expected) - got)}")
     for key, arr in targets.items():
         if tuple(arrays[key].shape) != tuple(arr.shape):
             raise ValueError(f"{key}: snapshot shape {arrays[key].shape} "
@@ -128,8 +215,15 @@ def restore_state(workflow, path: str) -> dict:
     workflow.loader.load_state_dict(loader_state)
     workflow.decision.load_state_dict(meta["decision"])
     prng.load_state_dict(meta["prng"])
+    # state_dict-only forwards (after the loader restore: their guards
+    # may depend on restored loader state, e.g. the LM vocab check)
+    for i, fwd in state_only.items():
+        fwd.load_state_dict(_unflatten_state(f"unitstate.{i}", arrays))
     step = getattr(workflow, "step", None)
-    if step is not None and getattr(step, "_params", None) is not None:
+    if step is not None and getattr(step, "_params", None) is not None \
+            and hasattr(step, "gather_params"):
+        # (state_dict-only steps — the transformer LM — restored above;
+        # this branch is the FusedTrainStep re-placement path)
         # optimizer identity is training state: resuming adam moments as
         # sgd momentum (or adam from zeroed second moments) would change
         # semantics silently — fail loudly like the architecture check.
@@ -254,7 +348,8 @@ class NNSnapshotter(SnapshotterToFile):
         super().export()
         for i, fwd in enumerate(self.target_workflow.forwards):
             for attr in ("weights", "bias"):
-                arr = getattr(fwd, attr)
+                # three-arg: state_dict-only forwards carry no Arrays
+                arr = getattr(fwd, attr, None)
                 if arr:
                     m = arr.map_read()
                     self.info(

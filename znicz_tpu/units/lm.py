@@ -75,11 +75,7 @@ class TransformerLMStep(AcceleratedUnit):
             self._params = tfm.init_params(
                 prng.get(), self.n_layers, self.d, self.heads, self.ff,
                 self.vocab_size)
-        specs = tfm.param_specs(self.n_layers)
-        self._params = jax.device_put(
-            self._params, jax.tree.map(
-                lambda s: NamedSharding(self.mesh, s), specs,
-                is_leaf=lambda x: isinstance(x, P)))
+        self._params = self._place_params(self._params)
         # masked=True: the loader's padded tail rows (base.py static-shape
         # policy) contribute neither loss nor gradients
         self._step, _ = tfm.make_train_step(
@@ -91,6 +87,20 @@ class TransformerLMStep(AcceleratedUnit):
         #: minibatch placement: batch over data, time over seq
         self._batch_sharding = NamedSharding(self.mesh, P("data", "seq"))
         self._mask_sharding = NamedSharding(self.mesh, P("data"))
+
+    def _place_params(self, params):
+        """Mesh placement by param_specs — the ONE layout used by init
+        and restore alike."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from znicz_tpu.parallel import transformer as tfm
+
+        specs = tfm.param_specs(self.n_layers)
+        return jax.device_put(
+            params, jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P)))
 
     # -- compute ------------------------------------------------------------
     def numpy_run(self) -> None:
@@ -129,25 +139,29 @@ class TransformerLMStep(AcceleratedUnit):
         if "params" not in state:
             return
         params = state["params"]
+        # architecture validation — the generic snapshot restore checks
+        # tree STRUCTURE; shape semantics are this unit's contract:
         restored_vocab = int(params["emb"].shape[0])
-        if self.vocab_size is not None and \
-                restored_vocab != self.vocab_size:
+        if len(params["blocks"]) != self.n_layers or \
+                int(params["emb"].shape[1]) != self.d or \
+                tuple(params["head"].shape) != (self.d, restored_vocab):
+            raise ValueError(
+                f"snapshot params (d={params['emb'].shape[1]}, "
+                f"{len(params['blocks'])} blocks) do not match this "
+                f"workflow (d={self.d}, {self.n_layers} blocks)")
+        # vocab must match what the loader SERVES NOW — after a restore
+        # the loader has adopted the snapshot vocab (CharSequenceLoader
+        # snapshots it), so a mismatch means a genuinely different corpus
+        live_vocab = int(self.loader.vocab_size) \
+            if self.loader is not None else self.vocab_size
+        if live_vocab and restored_vocab != live_vocab:
             raise ValueError(
                 f"snapshot params carry vocab {restored_vocab} but the "
-                f"loader serves vocab {self.vocab_size} — restore the "
-                f"loader state first (CharSequenceLoader snapshots its "
-                f"vocab) or use the matching corpus")
+                f"loader serves vocab {live_vocab} — the corpus does not "
+                f"match the snapshot")
+        self.vocab_size = restored_vocab
         if self._step is not None:
             # already initialized: only re-place the arrays onto the
-            # mesh — the compiled step/eval stay valid (same shapes)
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from znicz_tpu.parallel import transformer as tfm
-
-            specs = tfm.param_specs(self.n_layers)
-            params = jax.device_put(
-                params, jax.tree.map(
-                    lambda s: NamedSharding(self.mesh, s), specs,
-                    is_leaf=lambda x: isinstance(x, P)))
+            # mesh — the compiled step/eval stay valid
+            params = self._place_params(params)
         self._params = params
