@@ -579,9 +579,126 @@ def test_pallas_hw_parity_sweep_interpret():
     from znicz_tpu.utils.pallas_hw import run_parity
 
     res = run_parity(interpret=True)
-    assert set(res) == {"sgd", "adam", "dropout", "lrn", "conv_fwd",
-                        "conv_bwd", "deconv", "stochastic_pool",
-                        "kohonen", "flash_attention",
+    assert set(res) == {"sgd", "adam", "dropout", "lrn", "fc_gemm",
+                        "conv_fwd", "conv_bwd", "deconv",
+                        "stochastic_pool", "kohonen", "flash_attention",
                         "conv_fwd_bf16", "flash_attention_bf16"}
     bad = {k: v for k, v in res.items() if v != "ok"}
     assert not bad, bad
+
+
+# -- round-4 parity tail 2: the blocked FC GEMM (matrix_multiplication) ------
+
+from znicz_tpu.ops import linear as lin_ops
+from znicz_tpu.ops.pallas import fc_backward, fc_forward
+
+FC_GEOMS = [(32, 784, 100), (7, 13, 3), (129, 200, 257), (8, 128, 128)]
+
+
+@pytest.mark.parametrize("geom", FC_GEOMS)
+@pytest.mark.parametrize("act", ["linear", "tanh", "relu", "strict_relu",
+                                 "sigmoid"])
+def test_pallas_fc_gemm_matches_oracle(geom, act):
+    """Blocked-GEMM fc forward/backward vs ops.linear across padded and
+    exact-block geometries and every fused activation."""
+    B, F, O = geom
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(B, F)).astype(np.float32)
+    w = (rng.normal(size=(F, O)) * 0.05).astype(np.float32)
+    b = rng.normal(size=(O,)).astype(np.float32)
+    y_ref = lin_ops.forward(np, x, w, b, act)
+    y_pl = fc_forward(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), act,
+                      interpret=True)
+    np.testing.assert_allclose(np.asarray(y_pl), y_ref, rtol=1e-4,
+                               atol=1e-4)
+    e = rng.normal(size=(B, O)).astype(np.float32)
+    refs = lin_ops.backward(np, x, y_ref, w, e, act)
+    outs = fc_backward(jnp.asarray(x), jnp.asarray(y_ref), jnp.asarray(w),
+                       jnp.asarray(e), act, interpret=True)
+    for name, got, want in zip(("err_input", "grad_w", "grad_b"), outs,
+                               refs):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                                   atol=2e-3, err_msg=name)
+
+
+def test_pallas_fc_unit_selection():
+    """engine.pallas routes All2AllTanh + GDTanh through the blocked
+    GEMM kernels with identical training effect."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.all2all import All2AllTanh
+    from znicz_tpu.units.gd import GDTanh
+
+    def run_once():
+        prng.seed_all(19)
+        rng = np.random.default_rng(7)
+        w = Workflow(name="fc")
+        fwd = All2AllTanh(w, output_sample_shape=24)
+        fwd.input = Array(rng.normal(size=(16, 33)).astype(np.float32))
+        fwd.initialize(device=TPUDevice())
+        fwd.run()
+        gd = GDTanh(w, learning_rate=0.1, weights_decay=0.01,
+                    gradient_moment=0.9)
+        gd.link_from_forward(fwd)
+        gd.err_output = Array(rng.normal(size=fwd.output.shape)
+                              .astype(np.float32))
+        gd.batch_size = 16
+        gd.initialize(device=TPUDevice())
+        gd.run()
+        return {a: np.asarray(getattr(gd, a).map_read()).copy()
+                for a in ("err_input", "weights", "bias",
+                          "gradient_weights", "gradient_bias")}
+
+    base = run_once()
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        pallas = run_once()
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    for attr, want in base.items():
+        np.testing.assert_allclose(pallas[attr], want, rtol=2e-4,
+                                   atol=2e-5, err_msg=attr)
+
+
+def test_pallas_gd_override_cleared_on_numpy_reinit():
+    """A gd unit initialized under engine.pallas on XLA, then
+    re-initialized onto the numpy backend, must run the numpy oracle —
+    not the stale Pallas closure (GradientDescentBase.numpy_init)."""
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import NumpyDevice, TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.units.all2all import All2AllTanh
+    from znicz_tpu.units.gd import GDTanh
+
+    prng.seed_all(23)
+    rng = np.random.default_rng(9)
+    w = Workflow(name="t")
+    fwd = All2AllTanh(w, output_sample_shape=8)
+    fwd.input = Array(rng.normal(size=(4, 12)).astype(np.float32))
+    root.common.engine.pallas = True
+    root.common.engine.pallas_interpret = True
+    try:
+        fwd.initialize(device=TPUDevice())
+        fwd.run()
+        gd = GDTanh(w, learning_rate=0.1)
+        gd.link_from_forward(fwd)
+        gd.err_output = Array(rng.normal(size=fwd.output.shape)
+                              .astype(np.float32))
+        gd.batch_size = 4
+        gd.initialize(device=TPUDevice())
+        gd.run()
+        assert "_backward" in gd.__dict__      # override installed
+    finally:
+        root.common.engine.pallas = False
+        root.common.engine.pallas_interpret = False
+    gd.initialize(device=NumpyDevice())
+    assert "_backward" not in gd.__dict__      # override dropped
+    gd.run()                                   # numpy oracle, no jax
+    assert isinstance(gd.err_input.mem, np.ndarray)

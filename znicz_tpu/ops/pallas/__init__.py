@@ -23,3 +23,5 @@ from znicz_tpu.ops.pallas.pooling import stochastic_pool  # noqa: F401
 from znicz_tpu.ops.pallas.kohonen import som_step  # noqa: F401
 from znicz_tpu.ops.pallas.attention import flash_attention  # noqa: F401
 from znicz_tpu.ops.pallas.adam import fused_adam_update  # noqa: F401
+from znicz_tpu.ops.pallas.gemm import (  # noqa: F401
+    fc_backward, fc_forward, matmul)

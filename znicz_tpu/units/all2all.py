@@ -86,11 +86,22 @@ class All2All(Forward):
         self.output.mem = out.reshape((-1,) + self.output_sample_shape)
 
     def xla_init(self) -> None:
+        from znicz_tpu.core.config import root
+
         act = self.ACTIVATION
         shape = (-1,) + self.output_sample_shape
+        if bool(root.common.engine.get("pallas", False)):
+            # blocked-GEMM kernel with fused bias+activation (parity
+            # path — the reference's all2all/forward kernel)
+            from znicz_tpu.ops.pallas import gemm
+            interp = bool(root.common.engine.get("pallas_interpret", False))
 
-        def fn(x, w, b):
-            return linear.forward(jnp, x, w, b, act).reshape(shape)
+            def fn(x, w, b):
+                return gemm.fc_forward(x, w, b, act,
+                                       interpret=interp).reshape(shape)
+        else:
+            def fn(x, w, b):
+                return linear.forward(jnp, x, w, b, act).reshape(shape)
 
         self._xla_fn = jax.jit(fn)
 

@@ -40,9 +40,8 @@ class GradientDescent(GradientDescentBase):
         uses ``weights_transposed`` the GEMMs see the natural (in, out) view
         and the gradient is transposed back before the update."""
         w_natural = w.T if self.weights_transposed else w
-        err_in, grad_w, grad_b = linear.backward(
-            xp, x, y, w_natural, err_out, self.ACTIVATION,
-            self.ACTIVATION_APPLIED)
+        err_in, grad_w, grad_b = self._backward(xp, x, y, w_natural,
+                                                err_out)
         if self.weights_transposed:
             grad_w = grad_w.T
         if not self.need_err_input:
@@ -80,7 +79,32 @@ class GradientDescent(GradientDescentBase):
             self.gradient_bias.map_invalidate()
             self.gradient_bias.mem = vel_b
 
+    def _backward(self, xp, x, y, w_natural, err_out):
+        return linear.backward(xp, x, y, w_natural, err_out,
+                               self.ACTIVATION, self.ACTIVATION_APPLIED)
+
     def xla_init(self) -> None:
+        from znicz_tpu.core.config import root
+        from znicz_tpu.ops.pallas.gemm import FUSED_ACTIVATIONS
+
+        if bool(root.common.engine.get("pallas", False)) and \
+                self.ACTIVATION in FUSED_ACTIVATIONS:
+            # the reference's err_h_update/weights_update/bias_update
+            # trio as blocked Pallas GEMMs (parity path)
+            from znicz_tpu.ops.pallas.gemm import fc_backward
+            interp = bool(root.common.engine.get("pallas_interpret", False))
+            act, applied = self.ACTIVATION, self.ACTIVATION_APPLIED
+
+            def pallas_backward(xp, x, y, w_natural, err_out):
+                return fc_backward(x, y, w_natural, err_out, act, applied,
+                                   interpret=interp)
+
+            self._backward = pallas_backward
+        else:
+            # drop a stale instance override from a previous initialize
+            # under engine.pallas — the flag must toggle both ways
+            self.__dict__.pop("_backward", None)
+
         def fn(x, y, w, b, err_out, vel_w, vel_b, batch_size):
             return self._step(jnp, x, y, w, b,
                               linear.flatten_batch(jnp, err_out),

@@ -215,6 +215,13 @@ class GradientDescentBase(NNLayerBase):
         if self.bias and not self.gradient_bias:
             self.gradient_bias.mem = np.zeros_like(self.bias.mem)
 
+    def numpy_init(self) -> None:
+        # a re-initialize onto the numpy backend must drop any Pallas
+        # ``_backward`` override a previous XLA initialize installed
+        # under engine.pallas (gd/gd_conv/gd_deconv) — the numpy oracle
+        # path must never run jax kernels
+        self.__dict__.pop("_backward", None)
+
     def link_from_forward(self, forward: Forward) -> "GradientDescentBase":
         """Wire the standard data links from the paired forward unit."""
         self.link_attrs(forward, "input", "output", "weights", "bias")

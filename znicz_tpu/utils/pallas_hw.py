@@ -208,6 +208,23 @@ def run_parity(interpret: bool = False) -> dict:
                 np.asarray(a, np.float32), np.asarray(b_, np.float32),
                 rtol=grad_rtol, atol=grad_atol)
 
+    def fc_gemm():
+        from znicz_tpu.ops import linear as lin_ops
+        x = jnp.asarray(rng.normal(size=(64, 256)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(256, 128)) * 0.05, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+        y_ref = lin_ops.forward(jnp, x, w, b, "tanh")
+        y_pl = pk.fc_forward(x, w, b, "tanh", interpret=interpret)
+        np.testing.assert_allclose(np.asarray(y_pl), np.asarray(y_ref),
+                                   rtol=1e-4, atol=1e-4)
+        e = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+        refs = lin_ops.backward(jnp, x, y_ref, w, e, "tanh")
+        outs = pk.fc_backward(x, y_ref, w, e, "tanh",
+                              interpret=interpret)
+        for got, want in zip(outs, refs):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-4, atol=1e-3)
+
     def conv_fwd_bf16():
         conv_fwd(dtype=jnp.bfloat16, rtol=5e-2, atol=5e-1)
 
@@ -216,7 +233,8 @@ def run_parity(interpret: bool = False) -> dict:
                         grad_rtol=1e-1, grad_atol=5e-1)
 
     for name, fn in (("sgd", sgd), ("adam", adam), ("dropout", dropout),
-                     ("lrn", lrn), ("conv_fwd", conv_fwd),
+                     ("lrn", lrn), ("fc_gemm", fc_gemm),
+                     ("conv_fwd", conv_fwd),
                      ("conv_bwd", conv_bwd), ("deconv", deconv),
                      ("stochastic_pool", stochastic_pool),
                      ("kohonen", kohonen),
