@@ -62,7 +62,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     o = jnp.dot(p.astype(v.dtype), v,
                 preferred_element_type=jnp.float32) / l
     o_ref[0] = o.astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = m + jnp.log(l)                        # (bq, 1)
 
 
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -78,8 +78,8 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q = q_ref[0]                                       # (bq, dh)
     k = k_ref[0]                                       # (t, dh)
     v = v_ref[0]
-    lse = lse_ref[0][:, None]                          # (bq, 1)
-    delta = delta_ref[0][:, None]                      # (bq, 1)
+    lse = lse_ref[0]                                   # (bq, 1)
+    delta = delta_ref[0]                               # (bq, 1)
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
@@ -136,12 +136,16 @@ def _call_fwd(q, k, v, causal, interpret):
         out_specs=[
             pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+            # lse rides as (bh, t, 1): a 2-D (1, block_q) block is not a
+            # legal Mosaic tile (penultimate dim 1 is neither 8-divisible
+            # nor the full bh axis) — the trailing singleton makes the
+            # last-two block dims (block_q, 1) == (8k-divisible, full dim)
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             _out_struct((bh, t, dh), q.dtype, q),
-            _out_struct((bh, t), jnp.float32, q),
+            _out_struct((bh, t, 1), jnp.float32, q),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -156,8 +160,10 @@ def _flash_bwd(causal, interpret, res, do):
     q, k, v, o, lse = res
     bh, t, dh = q.shape
     block_q = _pick_block_q(t)
-    # Δ = rowsum(do ⊙ o) — the lse-side term of the softmax jacobian
-    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    # Δ = rowsum(do ⊙ o) — the lse-side term of the softmax jacobian;
+    # shaped (bh, t, 1) like lse for the same Mosaic-tiling reason
+    delta = (do.astype(jnp.float32) *
+             o.astype(jnp.float32)).sum(-1, keepdims=True)
     kern = partial(_bwd_kernel, causal=causal,
                    sm_scale=1.0 / float(np.sqrt(dh)), block_q=block_q)
     full = lambda shape: pl.BlockSpec(                 # noqa: E731
@@ -166,8 +172,8 @@ def _flash_bwd(causal, interpret, res, do):
     qblk3 = lambda: pl.BlockSpec((1, block_q, dh),     # noqa: E731
                                  lambda i, j: (i, j, 0),
                                  memory_space=pltpu.VMEM)
-    qblk2 = lambda: pl.BlockSpec((1, block_q),         # noqa: E731
-                                 lambda i, j: (i, j),
+    qblk2 = lambda: pl.BlockSpec((1, block_q, 1),      # noqa: E731
+                                 lambda i, j: (i, j, 0),
                                  memory_space=pltpu.VMEM)
     dq, dk, dv = pl.pallas_call(
         kern,

@@ -35,6 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from znicz_tpu.ops.conv import normalize_geometry, out_size
+from znicz_tpu.ops.pallas.conv import (load_planes, phase_split,
+                                       tap_slice)
 
 
 def _adjoint_kernel(dp_ref, wf_ref, out_ref, *, ky, kx, hp, wp):
@@ -52,13 +54,14 @@ def _adjoint_kernel(dp_ref, wf_ref, out_ref, *, ky, kx, hp, wp):
     out_ref[0] = acc.reshape(hp, wp, na).astype(out_ref.dtype)
 
 
-def _grad_kernel(xpad_ref, e_ref, gw_ref, gb_ref, *,
+def _grad_kernel(xph_ref, e_ref, gw_ref, gb_ref, *,
                  ky, kx, sy, sx, oh, ow):
     """Per-tap transposed GEMM ``gw[tap] += xtapᵀ @ e``, f32-accumulated
-    across the batch grid (outputs are revisited every step)."""
+    across the batch grid (outputs are revisited every step).  Taps come
+    from the phase-split input (see ops.pallas.conv) — Mosaic cannot
+    lower strided in-kernel slices."""
     i = pl.program_id(0)
-    x = xpad_ref[0]                                # (hp, wp, cin)
-    cin = x.shape[-1]
+    cin = xph_ref.shape[-1]
     cout = e_ref.shape[-1]
     e = e_ref[0].reshape(oh * ow, cout)
 
@@ -67,12 +70,10 @@ def _grad_kernel(xpad_ref, e_ref, gw_ref, gb_ref, *,
         gw_ref[...] = jnp.zeros_like(gw_ref)
         gb_ref[...] = jnp.zeros_like(gb_ref)
 
+    planes = load_planes(xph_ref, sy, sx)
     for iy in range(ky):
         for ix in range(kx):
-            tap = lax.slice(
-                x, (iy, ix, 0),
-                (iy + (oh - 1) * sy + 1, ix + (ow - 1) * sx + 1, cin),
-                (sy, sx, 1))                       # (oh, ow, cin)
+            tap = tap_slice(planes, iy, ix, sy, sx, oh, ow)
             gw_ref[iy, ix] += lax.dot_general(
                 tap.reshape(oh * ow, cin), e, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -110,14 +111,16 @@ def _adjoint_call(dp, wf, hp, wp, ky, kx, out_dtype, interpret):
 
 
 def _grad_call(xpad, e, ky, kx, sy, sx, oh, ow, interpret):
-    n, hp, wp, cin = xpad.shape
+    xph = phase_split(xpad, sy, sx)
+    n, _, _, hq, wq, cin = xph.shape
     cout = e.shape[-1]
     kern = partial(_grad_kernel, ky=ky, kx=kx, sy=sy, sx=sx, oh=oh, ow=ow)
     return pl.pallas_call(
         kern,
         grid=(n,),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, cin), lambda i: (i, 0, 0, 0),
+            pl.BlockSpec((1, sy, sx, hq, wq, cin),
+                         lambda i: (i, 0, 0, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, oh, ow, cout), lambda i: (i, 0, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -133,7 +136,7 @@ def _grad_call(xpad, e, ky, kx, sy, sx, oh, ow, interpret):
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
         ],
         interpret=interpret,
-    )(xpad, e)
+    )(xph, e)
 
 
 def conv2d_backward(x, weights, err_v, sliding=(1, 1),
