@@ -17,14 +17,18 @@ from znicz_tpu.ops.pallas._elementwise import tiled_update
 
 
 def _kernel(h_ref, w_ref, g_ref, m_ref, v_ref, w_out, m_out, v_out):
-    lr, wd, b1, b2, eps, t, bs = (h_ref[0], h_ref[1], h_ref[2], h_ref[3],
-                                  h_ref[4], h_ref[5], h_ref[6])
+    # bias corrections c1 = 1-b1^t, c2 = 1-b2^t are computed OUTSIDE the
+    # kernel: a scalar pow on SMEM operands crashes the Mosaic scalar
+    # core's compiler (observed on-chip as a remote_compile HTTP 500)
+    lr, wd, b1, b2, eps, c1, c2, bs = (
+        h_ref[0], h_ref[1], h_ref[2], h_ref[3], h_ref[4], h_ref[5],
+        h_ref[6], h_ref[7])
     w = w_ref[:]
     g = g_ref[:] / bs
     m = b1 * m_ref[:] + (1.0 - b1) * g
     v = b2 * v_ref[:] + (1.0 - b2) * (g * g)
-    mhat = m / (1.0 - b1 ** t)
-    vhat = v / (1.0 - b2 ** t)
+    mhat = m / c1
+    vhat = v / c2
     w_out[:] = w - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * w)
     m_out[:] = m
     v_out[:] = v
@@ -36,9 +40,13 @@ def fused_adam_update(w, grad, m, v, t, learning_rate, weight_decay,
     """(w, m, v) -> (w', m', v') with ops.adam.update semantics, one
     pass.  ``t`` is the POST-increment step count (caller advances it).
     Arrays of any rank; scalars may be traced."""
+    tf = jnp.asarray(t, jnp.float32)
+    c1 = 1.0 - jnp.asarray(beta1, jnp.float32) ** tf
+    c2 = 1.0 - jnp.asarray(beta2, jnp.float32) ** tf
     result = tiled_update(
         _kernel,
-        [learning_rate, weight_decay, beta1, beta2, eps, t, batch_size],
+        [learning_rate, weight_decay, beta1, beta2, eps, c1, c2,
+         batch_size],
         (w, grad, m, v), aliases={1: 0, 3: 1, 4: 2}, n_out=3,
         interpret=interpret)
     if result is None:

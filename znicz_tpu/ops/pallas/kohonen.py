@@ -25,9 +25,15 @@ def _kernel(s_ref, x_ref, w_ref, c_ref, wout_ref, idx_ref):
     w = w_ref[:]                                     # (N, D)
     coords = c_ref[:]                                # (N, 2)
     B, N = x.shape[0], w.shape[0]
+    # every dot runs at HIGHEST precision: the winner one-hot compares
+    # d2 against its row min EXACTLY, and default-precision MXU bf16
+    # passes flip winners vs the f32 oracle (measured on chip: 40% of
+    # weight elements diverged). The SOM step is dispatch-latency-bound
+    # (docs/BENCH_LOG.md roofline), so the extra passes are free.
+    hi = jax.lax.Precision.HIGHEST
     x2 = (x * x).sum(axis=1, keepdims=True)          # (B, 1)
     w2 = (w * w).sum(axis=1)                         # (N,)
-    d2 = x2 - 2.0 * jnp.dot(x, w.T,
+    d2 = x2 - 2.0 * jnp.dot(x, w.T, precision=hi,
                             preferred_element_type=jnp.float32) + w2
     dmin = d2.min(axis=1, keepdims=True)
     # winner one-hot WITHOUT gather: smallest column index attaining the
@@ -37,16 +43,17 @@ def _kernel(s_ref, x_ref, w_ref, c_ref, wout_ref, idx_ref):
     onehot = (col == idx).astype(jnp.float32)        # (B, N)
     idx_ref[:] = idx
     # neighborhood of each sample's winner over the grid
-    wc = jnp.dot(onehot, coords,
+    wc = jnp.dot(onehot, coords, precision=hi,
                  preferred_element_type=jnp.float32)  # (B, 2)
     wc2 = (wc * wc).sum(axis=1, keepdims=True)
     c2 = (coords * coords).sum(axis=1)
-    g2 = wc2 - 2.0 * jnp.dot(wc, coords.T,
+    g2 = wc2 - 2.0 * jnp.dot(wc, coords.T, precision=hi,
                              preferred_element_type=jnp.float32) + c2
     h = jnp.exp(-g2 / (2.0 * sigma * sigma))         # (B, N)
     row = jax.lax.broadcasted_iota(jnp.int32, (B, N), 0).astype(jnp.float32)
     h = jnp.where(row < bs, h, 0.0)                  # mask padded samples
-    num = jnp.dot(h.T, x, preferred_element_type=jnp.float32)   # (N, D)
+    num = jnp.dot(h.T, x, precision=hi,
+                  preferred_element_type=jnp.float32)  # (N, D)
     den = h.sum(axis=0)[:, None]                     # (N, 1)
     wout_ref[:] = w + alpha * (num - den * w) / (den + 1.0)
 

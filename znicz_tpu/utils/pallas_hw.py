@@ -182,7 +182,11 @@ def run_parity(interpret: bool = False) -> dict:
         # bf16 backward (ds/dq emitted in q.dtype, bf16 MXU operands) is
         # what production training runs and must prove its own lowering
         dtype = dtype or jnp.float32
-        if dtype == jnp.float32 and jax.default_backend() != "cpu":
+        if dtype == jnp.float32 and jax.default_backend() in ("tpu",
+                                                              "axon"):
+            # TPU-family backends only ("axon" is this sandbox's TPU
+            # platform name): the band below reflects MXU default
+            # precision; an exact-f32 backend must keep the tight band
             # on TPU both the oracle's and the kernel's f32 matmuls run
             # MXU bf16 passes (default precision); measured on-chip the
             # two *oracle* precisions differ by ~1.2e-2 max abs and the
