@@ -11,6 +11,8 @@ delta stabilizes.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 import jax
@@ -21,6 +23,47 @@ from znicz_tpu.core.memory import Array
 from znicz_tpu.core.accelerated_units import AcceleratedUnit
 from znicz_tpu.ops import kohonen as k_ops
 from znicz_tpu.units.decision import DecisionBase
+
+
+def _som_batch_step(x, w, coords, alpha, radius, bs, *, pallas: bool,
+                    interpret: bool):
+    """THE one SOM batch-update rule — shared by the per-minibatch jit
+    and the epoch scan so the two modes cannot drift."""
+    if pallas:
+        from znicz_tpu.ops.pallas import som_step
+        new_w, idx = som_step(x, w, coords, alpha, radius, bs,
+                              interpret=interpret)
+        return new_w, idx.astype(jnp.int32)
+    mask = jnp.arange(x.shape[0]) < bs
+    new_w, idx = k_ops.update(jnp, x, w, coords, alpha, radius, mask)
+    return new_w, idx.astype(jnp.int32)
+
+
+_som_batch_step_jit = jax.jit(_som_batch_step,
+                              static_argnames=("pallas", "interpret"))
+
+
+@partial(jax.jit, static_argnames=("pallas", "interpret"))
+def _epoch_scan(dataset, w, coords, idxs, ms, alpha, radius, *,
+                pallas: bool, interpret: bool):
+    """One compiled class pass over the pinned dataset PLUS the decision
+    metric ``|ΔW|/|W|`` in the same dispatch — the per-epoch host round
+    trip is then a single scalar fetch.  Module-level (not a per-workflow
+    closure) so jit's in-process cache carries across workflow builds:
+    a warm-up build genuinely warms the timed build (the closure version
+    re-traced per build, and on hardware the re-trace + persistent-cache
+    reload dominated the whole measured SOM run — docs/BENCH_LOG.md)."""
+    def body(wc, inp):
+        idx, m = inp
+        new_w, _ = _som_batch_step(dataset[idx], wc, coords, alpha,
+                                   radius, m.sum(), pallas=pallas,
+                                   interpret=interpret)
+        return new_w, None
+
+    new_w, _ = jax.lax.scan(body, w, (idxs, ms))
+    delta = jnp.abs(new_w - w).sum() / jnp.maximum(
+        jnp.abs(w).sum(), 1e-12)
+    return new_w, delta
 
 
 class KohonenBase(AcceleratedUnit):
@@ -73,11 +116,17 @@ class KohonenTrainer(KohonenBase):
         self.scan_epoch = None
         self._scan_fn = None
         self._dataset_dev = None
+        self._coords_dev = None
         self._scan_in_flight = False  # current class pass scan-dispatched
+        #: device scalar |ΔW|/|W| of the last scan-dispatched pass —
+        #: KohonenDecision fetches it (ONE d2h fence per epoch) instead
+        #: of reading full weights twice
+        self.scan_delta_dev = None
         #: weights as of the START of the current epoch (consumed by
-        #: KohonenDecision's |ΔW| metric — its own capture point runs
-        #: after this unit, which would miss the first minibatch's
-        #: movement, or in scan mode the whole pass)
+        #: KohonenDecision's |ΔW| metric on the PER-MINIBATCH path —
+        #: its own capture point runs after this unit, which would miss
+        #: the first minibatch's movement).  Scan mode never populates
+        #: it: the delta rides the dispatch as ``scan_delta_dev``
         self.epoch_start_weights = None
         self._snap_epoch = None
 
@@ -141,27 +190,18 @@ class KohonenTrainer(KohonenBase):
         from znicz_tpu.core.config import root
 
         coords = jnp.asarray(self._coords_np)
-        if bool(root.common.engine.get("pallas", False)):
-            # fused distance+argmin+update kernel: weights read and
-            # written once per batch step
-            from znicz_tpu.ops.pallas import som_step
-            interp = bool(root.common.engine.get("pallas_interpret", False))
+        self._coords_dev = coords
+        # pallas=True selects the fused distance+argmin+update kernel:
+        # weights read and written once per batch step
+        self._use_pallas = bool(root.common.engine.get("pallas", False))
+        self._interp = bool(root.common.engine.get("pallas_interpret",
+                                                   False))
+        self._xla_fn = partial(_som_batch_step_jit,
+                               pallas=self._use_pallas,
+                               interpret=self._interp)
+        self._maybe_enable_scan()
 
-            def fn(x, w, alpha, radius, bs):
-                new_w, idx = som_step(x, w, coords, alpha, radius, bs,
-                                      interpret=interp)
-                return new_w, idx.astype(jnp.int32)
-        else:
-            def fn(x, w, alpha, radius, bs):
-                mask = jnp.arange(x.shape[0]) < bs
-                new_w, idx = k_ops.update(jnp, x, w, coords, alpha, radius,
-                                          mask)
-                return new_w, idx.astype(jnp.int32)
-
-        self._xla_fn = jax.jit(fn)
-        self._maybe_enable_scan(fn)
-
-    def _maybe_enable_scan(self, step_fn) -> None:
+    def _maybe_enable_scan(self) -> None:
         """Pin the loader's full-batch dataset on device and compile the
         per-class-pass scan (one dispatch per pass; class-plan padding
         sits at the tail, so the per-step ``bs`` mask stays valid)."""
@@ -186,17 +226,8 @@ class KohonenTrainer(KohonenBase):
         if data.nbytes > limit:
             return
         self._dataset_dev = jnp.asarray(data)
-
-        def epoch_fn(w, idxs, ms, alpha, radius):
-            def body(w, inp):
-                idx, m = inp
-                new_w, _ = step_fn(self._dataset_dev[idx], w, alpha,
-                                   radius, m.sum())
-                return new_w, None
-            w, _ = jax.lax.scan(body, w, (idxs, ms))
-            return w
-
-        self._scan_fn = jax.jit(epoch_fn)
+        self._scan_fn = partial(_epoch_scan, pallas=self._use_pallas,
+                                interpret=self._interp)
         loader.capture_class_plan = True
         # NOTE: the loader keeps filling minibatch_data — KohonenForward
         # (winner maps / hits plotters) and the mid-pass-resume fallback
@@ -215,11 +246,12 @@ class KohonenTrainer(KohonenBase):
             if int(self.loader.minibatch_offset) == 0:
                 from znicz_tpu.loader.base import plan_device_arrays
                 idxs, ms = plan_device_arrays(self.loader.class_plan())
-                self._maybe_snapshot_epoch_start()
                 self.weights.unmap()
-                new_w = self._scan_fn(self.weights.devmem, idxs, ms,
-                                      self.alpha, self.radius)
+                new_w, delta = self._scan_fn(
+                    self._dataset_dev, self.weights.devmem,
+                    self._coords_dev, idxs, ms, self.alpha, self.radius)
                 self.weights.set_devmem(new_w)
+                self.scan_delta_dev = delta      # fetched by the decision
                 self._scan_in_flight = True
             if self.loader.last_minibatch:
                 self._scan_in_flight = False
@@ -233,7 +265,7 @@ class KohonenTrainer(KohonenBase):
         x = self.input.devmem
         new_w, idx = self._xla_fn(
             x.reshape(x.shape[0], -1), self.weights.devmem,
-            self.alpha, self.radius,
+            self._coords_dev, self.alpha, self.radius,
             self.current_batch_size(self.input))
         self.weights.set_devmem(new_w)
         self.winners.set_devmem(idx)
@@ -296,12 +328,20 @@ class KohonenDecision(DecisionBase):
         self.weights_delta = 0.0
 
     def accumulate(self, cls: int) -> None:
+        if getattr(self.trainer, "scan_delta_dev", None) is not None:
+            return            # metric rides the scan dispatch on device
         if self._epoch_start_w is None:
             pre = getattr(self.trainer, "epoch_start_weights", None)
             self._epoch_start_w = pre.copy() if pre is not None \
                 else self.trainer.weights.map_read().copy()
 
     def finalize_class(self, cls: int) -> float:
+        delta_dev = getattr(self.trainer, "scan_delta_dev", None)
+        if delta_dev is not None:
+            # scan mode: ONE scalar d2h is the whole per-epoch fence
+            self.weights_delta = float(jax.device_get(delta_dev))
+            self.trainer.scan_delta_dev = None
+            return self.weights_delta
         w = self.trainer.weights.map_read()
         denom = max(float(np.abs(self._epoch_start_w).sum()), 1e-12)
         self.weights_delta = float(

@@ -182,11 +182,12 @@ def run_parity(interpret: bool = False) -> dict:
         # bf16 backward (ds/dq emitted in q.dtype, bf16 MXU operands) is
         # what production training runs and must prove its own lowering
         dtype = dtype or jnp.float32
-        if dtype == jnp.float32 and jax.default_backend() in ("tpu",
-                                                              "axon"):
-            # TPU-family backends only ("axon" is this sandbox's TPU
-            # platform name): the band below reflects MXU default
-            # precision; an exact-f32 backend must keep the tight band
+        if dtype == jnp.float32 and jax.default_backend() != "cpu":
+            # accelerator backends run f32 matmuls at reduced default
+            # precision (TPU MXU: bf16 passes — measured on-chip, the
+            # two ORACLE precisions differ by ~1.2e-2 max abs with the
+            # kernel within 5e-3 of the default oracle; GPU: tf32) —
+            # only exact-f32 CPU keeps the tight band
             # on TPU both the oracle's and the kernel's f32 matmuls run
             # MXU bf16 passes (default precision); measured on-chip the
             # two *oracle* precisions differ by ~1.2e-2 max abs and the
