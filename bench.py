@@ -56,6 +56,27 @@ def _enable_compile_cache():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _maybe_profile():
+    """jax trace around the timed region when BENCH_PROFILE names a
+    directory; exception-safe so a mid-loop device failure (the wedging
+    pool this repo's watcher exists for) never leaves a trace open."""
+    import jax
+
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if not profile_dir:
+        yield
+        return
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
 def _throughput(step, x, labels, K: int = 8, reps: int = 3) -> float:
     """Shared timing protocol: K minibatches per dispatch via the step's
     ``train_steps`` scan (amortizes the per-call dispatch latency, ~14 ms
@@ -78,16 +99,12 @@ def _throughput(step, x, labels, K: int = 8, reps: int = 3) -> float:
 
     metrics = step.train_steps(xs, ys, ms)      # compile + warm
     float(jax.device_get(metrics["loss"]))
-    profile_dir = os.environ.get("BENCH_PROFILE")
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        metrics = step.train_steps(xs, ys, ms)
-    float(jax.device_get(metrics["loss"]))      # fences the whole chain
-    dt = time.perf_counter() - t0
-    if profile_dir:
-        jax.profiler.stop_trace()
+    with _maybe_profile():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            metrics = step.train_steps(xs, ys, ms)
+        float(jax.device_get(metrics["loss"]))  # fences the whole chain
+        dt = time.perf_counter() - t0
     return batch * K * reps / dt
 
 
@@ -271,7 +288,7 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
         params = tfm.init_params(prng.get(), n_layers, d, heads, 4 * d,
                                  vocab)
         step, _ = tfm.make_train_step(mesh, n_layers, d, heads, 4 * d,
-                                      vocab, lr=1e-3)
+                                      vocab, lr=1e-3, donate=True)
         params, loss = step(params, tokens, labels)   # compile + warm
         float(jax.device_get(loss))
     except Exception as exc:  # noqa: BLE001 — flash may not lower here
@@ -285,18 +302,20 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
             params = tfm.init_params(prng.get(), n_layers, d, heads,
                                      4 * d, vocab)
             step, _ = tfm.make_train_step(mesh, n_layers, d, heads,
-                                          4 * d, vocab, lr=1e-3)
+                                          4 * d, vocab, lr=1e-3,
+                                          donate=True)
             params, loss = step(params, tokens, labels)
             float(jax.device_get(loss))
         finally:
             root_cfg.common.engine.flash_attention = prev
     print(f"# transformer ({attention}): initialized in "
           f"{time.time() - t0:.1f}s", file=sys.stderr)
-    t0 = time.perf_counter()
-    for _ in range(K * reps):
-        params, loss = step(params, tokens, labels)
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
+    with _maybe_profile():
+        t0 = time.perf_counter()
+        for _ in range(K * reps):
+            params, loss = step(params, tokens, labels)
+        float(jax.device_get(loss))
+        dt = time.perf_counter() - t0
     tps = batch * seq * K * reps / dt
     # MFU via the standard 6*N*T estimate (params N dominated by matmuls)
     n_params = sum(int(np.prod(np.shape(p)))

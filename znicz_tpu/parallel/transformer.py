@@ -154,7 +154,7 @@ def _check_tp(mesh: Mesh, heads: int, d: int, ff: int) -> int:
 
 
 def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
-                interp, cdt):
+                interp, cdt, remat: bool = False):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -162,8 +162,12 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     padding contract (loader/base.py)."""
     ps = jax.tree.map(lambda w: w.astype(cdt), ps)
     x = ps["emb"][tokens]                         # (b_l, t_l, d)
+    blk = _block
+    if remat:
+        blk = jax.checkpoint(
+            _block, static_argnums=(2, 3, 4, 5))  # type: ignore[assignment]
     for p in ps["blocks"]:
-        x = _block(x, p, heads_local, causal, use_flash, interp)
+        x = blk(x, p, heads_local, causal, use_flash, interp)
     logits = (x @ ps["head"]).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
@@ -199,10 +203,18 @@ def _shardmap_kwargs(use_flash: bool, interp: bool) -> dict:
 def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
                     compute_dtype=None, shard_update: bool = False,
-                    masked: bool = False):
+                    masked: bool = False, donate: bool = False,
+                    remat: bool = False):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
+
+    ``donate=True`` donates the params buffers to the step (the training
+    loop's natural contract — the caller rebinds; the old pytree is dead
+    after the call), halving parameter HBM traffic.  ``remat=True``
+    wraps each block in ``jax.checkpoint``: backward recomputes block
+    activations instead of saving them — the standard long-context
+    trade (HBM for FLOPs) once t grows past what activations fit.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -244,7 +256,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     def local_step(params, tokens, labels, mask=None):
         def loss_fn(ps):
             return _forward_ce(ps, tokens, labels, mask, heads_local,
-                               causal, use_flash, interp, cdt)
+                               causal, use_flash, interp, cdt,
+                               remat=remat)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
@@ -277,7 +290,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     step = shard_map(
         local_step, mesh=mesh, in_specs=in_specs,
         out_specs=(specs, P()), **_shardmap_kwargs(use_flash, interp))
-    return jax.jit(step), specs
+    return jax.jit(step, donate_argnums=(0,) if donate else ()), specs
 
 
 def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,

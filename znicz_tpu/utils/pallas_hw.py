@@ -188,11 +188,6 @@ def run_parity(interpret: bool = False) -> dict:
             # two ORACLE precisions differ by ~1.2e-2 max abs with the
             # kernel within 5e-3 of the default oracle; GPU: tf32) —
             # only exact-f32 CPU keeps the tight band
-            # on TPU both the oracle's and the kernel's f32 matmuls run
-            # MXU bf16 passes (default precision); measured on-chip the
-            # two *oracle* precisions differ by ~1.2e-2 max abs and the
-            # kernel sits within 5e-3 of the default oracle — a 2e-4
-            # band only exists on exact-f32 platforms
             rtol, atol = 2e-2, 2e-2
             grad_rtol, grad_atol = 5e-2, 1e-1
         b, t, h, dh = 2, 512, 2, 128
