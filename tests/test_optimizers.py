@@ -758,3 +758,116 @@ def test_everything_on_composition(tmp_path, cpu_devices):
     w2.step.sync_to_units()
     np.testing.assert_array_equal(w.forwards[0].weights.map_read(),
                                   w2.forwards[0].weights.map_read())
+
+
+# -- narrow optimizer-state storage (state_dtype) ---------------------------
+
+def build_sgd_momentum(max_epochs=3, seed=55, state_dtype=None):
+    """SGD+momentum workflow; momentum matters (gradient_moment=0.9)."""
+    prng.seed_all(seed)
+    hp = {"learning_rate": 0.05, "learning_rate_bias": 0.05,
+          "gradient_moment": 0.9, "gradient_moment_bias": 0.9,
+          "weights_decay": 1e-4, "weights_decay_bias": 1e-4}
+    cfg = {"state_dtype": state_dtype} if state_dtype else None
+    return StandardWorkflow(
+        name="SgdState", loss_function="softmax", layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": dict(hp)},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": dict(hp)}],
+        loader_name="synthetic_classifier",
+        loader_config={"n_classes": 4, "sample_shape": (6,), "n_train": 40,
+                       "n_valid": 0, "minibatch_size": 40},
+        decision_config={"max_epochs": max_epochs},
+        optimizer="sgd", optimizer_config=cfg)
+
+
+def test_state_dtype_bf16_tracks_f32():
+    """bf16 momentum storage: velocity leaves live narrow inside the
+    step, the unit-facing buffers stay f32, and the 6-epoch trajectory
+    tracks the f32 run closely (math is f32 — only persistence narrows)."""
+    runs = {}
+    for sd in (None, "bfloat16"):
+        w = build_sgd_momentum(max_epochs=6, seed=91, state_dtype=sd)
+        w.initialize(device=TPUDevice())
+        want = jnp.bfloat16 if sd else jnp.float32
+        assert w.step._params[0]["vw"].dtype == want
+        w.run()
+        w.step.sync_to_units()
+        assert w.forwards[0].weights.map_read().dtype == np.float32
+        assert np.asarray(
+            w.gds[0].gradient_weights.map_read()).dtype == np.float32
+        runs[sd] = [np.asarray(f.weights.map_read()).copy()
+                    for f in w.forwards]
+    for a, b in zip(runs[None], runs["bfloat16"]):
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=5e-3)
+
+
+def test_state_dtype_snapshot_resume_bit_exact(tmp_path):
+    """f32 snapshot of bf16 momenta widens exactly, so interrupt/resume
+    under state_dtype reproduces the uninterrupted run bit-exactly."""
+    from znicz_tpu.snapshotter import collect_state, restore_state, \
+        write_snapshot
+
+    def final_weights(w):
+        w.step.sync_to_units()
+        return [np.asarray(f.weights.map_read()).copy()
+                for f in w.forwards]
+
+    w_full = build_sgd_momentum(max_epochs=6, seed=17,
+                                state_dtype="bfloat16")
+    w_full.initialize(device=TPUDevice())
+    w_full.run()
+    want = final_weights(w_full)
+
+    w_a = build_sgd_momentum(max_epochs=3, seed=17,
+                             state_dtype="bfloat16")
+    w_a.initialize(device=TPUDevice())
+    w_a.run()
+    arrays, meta = collect_state(w_a)
+    snap = str(tmp_path / "sgdstate.npz")
+    write_snapshot(snap, arrays, meta)
+
+    w_b = build_sgd_momentum(max_epochs=6, seed=17,
+                             state_dtype="bfloat16")
+    w_b.initialize(device=TPUDevice())
+    restore_state(w_b, snap)
+    w_b.decision.max_epochs = 6
+    w_b.decision.complete.set(False)
+    w_b.run()
+    got = final_weights(w_b)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_state_dtype_rejected_for_adam():
+    with pytest.raises(ValueError, match="state_dtype"):
+        build_adam(optimizer_config={"state_dtype": "bfloat16"})
+
+
+def test_state_dtype_shard_update_scan(cpu_devices):
+    """state_dtype composes with the ZeRO-sharded update and scan-epoch
+    dispatch: momenta stay narrow through _flat_shard_put (it must not
+    widen them — the scan carry would then flip dtypes and crash) and the
+    sharded bf16-state run tracks the replicated one."""
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    weights = {}
+    for mode in (False, True):
+        prng.seed_all(31)
+        w = build_fused(max_epochs=3, layers=(23,), minibatch_size=32,
+                        n_train=160, n_valid=64,
+                        mesh=data_parallel_mesh(8),
+                        optimizer="sgd", shard_update=mode,
+                        optimizer_config={"state_dtype": "bfloat16"})
+        w.step.scan_epoch = True
+        w.initialize(device=TPUDevice())
+        assert w.step._params[0]["vw"].dtype == jnp.bfloat16, \
+            "narrowing undone by the sharded placement"
+        w.run()
+        w.step.sync_to_units()
+        weights[mode] = [np.asarray(f.weights.map_read()).copy()
+                        for f in w.forwards]
+    for a, b in zip(weights[True], weights[False]):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)

@@ -582,7 +582,8 @@ def test_pallas_hw_parity_sweep_interpret():
     assert set(res) == {"sgd", "adam", "dropout", "lrn", "fc_gemm",
                         "conv_fwd", "conv_bwd", "deconv",
                         "stochastic_pool", "kohonen", "flash_attention",
-                        "conv_fwd_bf16", "flash_attention_bf16"}
+                        "conv_fwd_bf16", "flash_attention_bf16",
+                        "sgd_bf16state"}
     bad = {k: v for k, v in res.items() if v != "ok"}
     assert not bad, bad
 
@@ -702,3 +703,25 @@ def test_pallas_gd_override_cleared_on_numpy_reinit():
     assert "_backward" not in gd.__dict__      # override dropped
     gd.run()                                   # numpy oracle, no jax
     assert isinstance(gd.err_input.mem, np.ndarray)
+
+
+def test_fused_sgd_narrow_state():
+    """bf16 velocity storage through the kernel: f32 math in-tile, one
+    narrow store, velocity dtype preserved (both tiled and fallback
+    shapes)."""
+    rng = np.random.default_rng(5)
+    for shape in ((64, 128), (3, 5, 16)):
+        w = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        g = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        v = jnp.asarray(rng.normal(size=shape) * 0.1, jnp.bfloat16)
+        args = (0.05, 1e-3, 0.3, 0.9, 32.0)
+        w_ref, v_ref = sgd_ops.update(jnp, w, g, v.astype(jnp.float32),
+                                      *args)
+        w_pl, v_pl = fused_sgd_update(w, g, v, *args, interpret=True)
+        assert v_pl.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(w_pl), np.asarray(w_ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(v_pl, dtype=np.float32),
+            np.asarray(v_ref.astype(jnp.bfloat16), dtype=np.float32),
+            rtol=1e-5, atol=1e-6)

@@ -32,17 +32,20 @@ def out_struct(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _pick_tile(rows: int, cols: int, n_buffers: int) -> int:
+def _pick_tile(rows: int, cols: int, n_buffers: int,
+               min_tile: int = 1) -> int:
     """Largest workable row tile: whole-array when it fits (one grid
     step), else the biggest power-of-two divisor of ``rows`` that fits,
-    else 0 (= no tile fits; caller must fall back)."""
+    else 0 (= no tile fits; caller must fall back).  ``min_tile`` guards
+    Mosaic's sublane tiling: 16-bit refs need (16, 128)-divisible blocks
+    unless the block spans the whole array."""
     def fits(t: int) -> bool:
         return t * cols * 4 * n_buffers <= VMEM_BUDGET
 
     if fits(rows):
         return rows
     for t in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if rows % t == 0 and fits(t):
+        if t >= min_tile and rows % t == 0 and fits(t):
             return t
     return 0
 
@@ -58,21 +61,30 @@ def tiled_update(kernel, hyper_scalars, arrays, aliases: dict,
     a2 = [a.reshape(-1, orig_shape[-1]) if a.ndim != 2 else a
           for a in arrays]
     rows, cols = a2[0].shape
-    tile = _pick_tile(rows, cols, len(arrays) + n_out)
+    # 16-bit buffers (narrow optimizer state) tile at (16, 128) sublanes
+    min_tile = 16 if any(jnp.dtype(a.dtype).itemsize < 4 for a in a2) \
+        else 1
+    tile = _pick_tile(rows, cols, len(arrays) + n_out, min_tile)
     if tile == 0:
         return None
     hyper = jnp.stack([jnp.asarray(h, jnp.float32)
                        for h in hyper_scalars])
     spec = pl.BlockSpec((tile, cols), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
-    out = out_struct(a2[0].shape, a2[0].dtype, a2[0])
+    # each output inherits shape/dtype/vma from the operand it aliases
+    # (narrow velocity stays narrow); non-aliased outputs mirror arrays[0]
+    src = {out_i: a2[in_i - 1] for in_i, out_i in aliases.items()}
+    outs = tuple(
+        out_struct(a2[0].shape, src.get(i, a2[0]).dtype,
+                   src.get(i, a2[0]))
+        for i in range(n_out))
     results = pl.pallas_call(
         kernel,
         grid=(rows // tile,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] +
                  [spec] * len(arrays),
         out_specs=(spec,) * n_out,
-        out_shape=(out,) * n_out,
+        out_shape=outs,
         input_output_aliases=dict(aliases),
         interpret=interpret,
     )(hyper, *a2)

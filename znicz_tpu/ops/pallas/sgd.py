@@ -21,9 +21,11 @@ def _kernel(h_ref, w_ref, g_ref, v_ref, w_out, v_out):
     w = w_ref[:]
     g = g_ref[:] / bs
     g = g + wd * ((1.0 - l1) * w + l1 * jnp.sign(w))
-    vel = mom * v_ref[:] + lr * g
+    # velocity may be stored narrow (state_dtype bf16): f32 math inside
+    # the tile, one narrow store — the single HBM pass is the point
+    vel = mom * v_ref[:].astype(w.dtype) + lr * g
     w_out[:] = w - vel
-    v_out[:] = vel
+    v_out[:] = vel.astype(v_out.dtype)
 
 
 def fused_sgd_update(w, grad, vel, learning_rate, weights_decay, l1_vs_l2,
@@ -40,7 +42,8 @@ def fused_sgd_update(w, grad, vel, learning_rate, weights_decay, l1_vs_l2,
         (w, grad, vel), aliases={1: 0, 3: 1}, n_out=2,
         interpret=interpret)
     if result is None:
-        return sgd_ops.update(jnp, w, grad, vel, learning_rate,
-                              weights_decay, l1_vs_l2, gradient_moment,
-                              batch_size)
+        w_new, vel_new = sgd_ops.update(
+            jnp, w, grad, vel.astype(w.dtype), learning_rate,
+            weights_decay, l1_vs_l2, gradient_moment, batch_size)
+        return w_new, vel_new.astype(vel.dtype)
     return result

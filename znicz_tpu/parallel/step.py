@@ -147,6 +147,20 @@ class FusedTrainStep(Unit):
         self.optimizer = optimizer
         self.optimizer_config = {**self.ADAM_DEFAULTS,
                                  **(optimizer_config or {})}
+        #: optional storage dtype for the SGD momentum buffers
+        #: (``optimizer_config={"state_dtype": "bfloat16"}``): the update
+        #: math stays f32 (cast in, cast out), only the persistent
+        #: velocity lives narrow — at large batch the f32 w+v HBM traffic
+        #: of the update rivals the matmul time, and halving the velocity
+        #: bytes is the remaining lever (docs/TUNING.md).  Snapshots
+        #: always store f32 (bf16->f32 is exact), so resume is bit-exact
+        #: and portable across the flag.
+        sd = self.optimizer_config.pop("state_dtype", None)
+        self.state_dtype = jnp.dtype(sd) if sd is not None else None
+        if self.state_dtype is not None and optimizer != "sgd":
+            raise ValueError(
+                "state_dtype applies to the SGD momentum buffers only "
+                "(adam moments need f32 second-moment accumulation)")
         #: dispatch one compiled lax.scan per CLASS PASS instead of one
         #: program per minibatch (requires the pinned dataset; same
         #: "virtual minibatch" Decision accounting as defer_metrics).
@@ -207,10 +221,12 @@ class FusedTrainStep(Unit):
 
     def _flat_shard_put(self, host_arr):
         """Flatten + pad an optimizer-state array and place it sharded
-        over the ``data`` axis (ZeRO layout)."""
+        over the ``data`` axis (ZeRO layout).  Dtype-preserving: callers
+        own the storage dtype (f32 snapshots/adam moments; state_dtype
+        momenta arrive pre-narrowed from put_state)."""
         from jax.sharding import NamedSharding
         n = self.mesh.shape["data"]
-        flat = np.asarray(host_arr, np.float32).reshape(-1)
+        flat = np.asarray(host_arr).reshape(-1)
         flat = np.pad(flat, (0, (-len(flat)) % n))
         return jax.device_put(flat, NamedSharding(self.mesh, P("data")))
 
@@ -223,18 +239,28 @@ class FusedTrainStep(Unit):
         rep = NamedSharding(self.mesh, P())
         put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
         put_v = self._flat_shard_put if self.shard_update else put
+
+        def put_state(a):
+            # momentum buffers live in state_dtype (unit Arrays / snapshots
+            # keep f32; the narrow copy exists only inside the step)
+            if self.state_dtype is not None:
+                a = np.asarray(a).astype(self.state_dtype)
+            return put_v(a)
+
         params = []
         for fwd, gd in zip(self.forwards, self.gds):
             leaf = {k: put(arr.map_read())
                     for k, arr in fwd.param_arrays().items()}
             if "w" in leaf:
-                leaf["vw"] = put_v(np.zeros_like(fwd.weights.map_read())) \
-                    if not gd.gradient_weights \
-                    else put_v(gd.gradient_weights.map_read())
+                leaf["vw"] = put_state(
+                    np.zeros_like(fwd.weights.map_read())
+                    if not gd.gradient_weights
+                    else gd.gradient_weights.map_read())
             if "b" in leaf:
-                leaf["vb"] = put_v(np.zeros_like(fwd.bias.map_read())) \
-                    if not gd.gradient_bias \
-                    else put_v(gd.gradient_bias.map_read())
+                leaf["vb"] = put_state(
+                    np.zeros_like(fwd.bias.map_read())
+                    if not gd.gradient_bias
+                    else gd.gradient_bias.map_read())
             if self.optimizer == "adam":
                 # vw/vb double as first moments; second moments + step
                 # count are step-level state (restored from snapshots via
@@ -358,21 +384,23 @@ class FusedTrainStep(Unit):
                 fwd.weights.set_devmem(leaf["w"])
             if "b" in leaf:
                 fwd.bias.set_devmem(leaf["b"])
+            widen = (lambda v: v.astype(jnp.float32)) \
+                if self.state_dtype is not None else (lambda v: v)
             if not self.shard_update:
                 if "w" in leaf:
-                    gd.gradient_weights.set_devmem(leaf["vw"])
+                    gd.gradient_weights.set_devmem(widen(leaf["vw"]))
                 if "b" in leaf:
-                    gd.gradient_bias.set_devmem(leaf["vb"])
+                    gd.gradient_bias.set_devmem(widen(leaf["vb"]))
                 continue
             # sharded momenta: reassemble to the param shape host-side
             if "w" in leaf:
                 gd.gradient_weights.map_invalidate()
-                gd.gradient_weights.mem = self._unshard_state(
-                    leaf["vw"], fwd.weights.shape)
+                gd.gradient_weights.mem = np.asarray(self._unshard_state(
+                    leaf["vw"], fwd.weights.shape), dtype=np.float32)
             if "b" in leaf:
                 gd.gradient_bias.map_invalidate()
-                gd.gradient_bias.mem = self._unshard_state(
-                    leaf["vb"], fwd.bias.shape)
+                gd.gradient_bias.mem = np.asarray(self._unshard_state(
+                    leaf["vb"], fwd.bias.shape), dtype=np.float32)
 
     # -- forward / loss composition -----------------------------------------
     def _forward_chain(self, params, x, train: bool, rng=None):
@@ -542,6 +570,18 @@ class FusedTrainStep(Unit):
                 return adam.update(jnp, w, g, m, s, t_new, lr, wd,
                                    cfg["beta1"], cfg["beta2"],
                                    cfg["eps"], bsz)
+
+            if self.state_dtype is not None:
+                # narrow-storage momenta on the XLA path: f32 math,
+                # state_dtype persistence (the Pallas kernel casts
+                # in-tile itself — wrapping it here would materialize a
+                # full f32 velocity copy and defeat the single pass)
+                base_upd = upd
+
+                def upd(w, g, v, lr, wd, l1, mom, bsz, _base=base_upd):
+                    w_new, v_new = _base(w, g, v.astype(w.dtype), lr, wd,
+                                         l1, mom, bsz)
+                    return w_new, v_new.astype(self.state_dtype)
 
         if self.shard_update:
             from znicz_tpu.parallel import zero

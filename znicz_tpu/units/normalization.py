@@ -32,11 +32,19 @@ class LRNormalizerForward(Forward):
         self.init_array(self.input, self.output)
 
     def xla_apply(self, p: dict, x, *, rng=None, train=True):
-        # normalization stays f32 under mixed precision (bandwidth-bound
-        # anyway; bf16 squares round away the alpha-scaled window sums)
-        y = lrn_ops.forward(jnp, x.astype(jnp.float32), self.alpha,
-                            self.beta, self.k, self.n)
-        return y.astype(x.dtype)
+        # normalization stays f32 under mixed precision (bf16 squares
+        # round away the alpha-scaled window sums).  Rematerialized: LRN
+        # sits on the largest activations in the nets that use it
+        # (AlexNet conv1/conv2), and without checkpoint AD keeps f32
+        # residuals of those alive across the whole backward pass —
+        # recomputing the window sums is ~10 VPU ops vs. hundreds of MB
+        # of HBM traffic per step.
+        def lrn(t):
+            y = lrn_ops.forward(jnp, t.astype(jnp.float32), self.alpha,
+                                self.beta, self.k, self.n)
+            return y.astype(t.dtype)
+
+        return jax.checkpoint(lrn)(x)
 
     def numpy_run(self) -> None:
         self.output.map_invalidate()

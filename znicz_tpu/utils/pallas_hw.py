@@ -240,6 +240,25 @@ def run_parity(interpret: bool = False) -> dict:
         flash_attention(dtype=jnp.bfloat16, rtol=5e-2, atol=5e-2,
                         grad_rtol=1e-1, grad_atol=5e-1)
 
+
+    def sgd_bf16state():
+        # narrow optimizer state: velocity stored bf16, f32 math in-tile
+        w = jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
+        g = jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(256, 256)) * 0.1, jnp.bfloat16)
+        args = (0.05, 1e-3, 0.3, 0.9, 32.0)
+        w_ref, v_ref = sgd_ops.update(jnp, w, g, v.astype(jnp.float32),
+                                      *args)
+        w_pl, v_pl = pk.fused_sgd_update(w, g, v, *args,
+                                         interpret=interpret)
+        assert v_pl.dtype == jnp.bfloat16, v_pl.dtype
+        np.testing.assert_allclose(np.asarray(w_pl), np.asarray(w_ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(v_pl, dtype=np.float32),
+            np.asarray(v_ref.astype(jnp.bfloat16), dtype=np.float32),
+            rtol=1e-5, atol=1e-6)
+
     for name, fn in (("sgd", sgd), ("adam", adam), ("dropout", dropout),
                      ("lrn", lrn), ("fc_gemm", fc_gemm),
                      ("conv_fwd", conv_fwd),
@@ -248,6 +267,7 @@ def run_parity(interpret: bool = False) -> dict:
                      ("kohonen", kohonen),
                      ("flash_attention", flash_attention),
                      ("conv_fwd_bf16", conv_fwd_bf16),
-                     ("flash_attention_bf16", flash_attention_bf16)):
+                     ("flash_attention_bf16", flash_attention_bf16),
+                     ("sgd_bf16state", sgd_bf16state)):
         _check(name, fn, results)
     return results
