@@ -467,11 +467,12 @@ def child_main(mode: str) -> None:
     _enable_compile_cache()
     bench_fc()
     flagship = bench_alexnet()
-    # remaining BASELINE configs; every line above already landed, so a
-    # timeout here only truncates the tail
-    for phase in (bench_cifar, bench_deconv_ae, bench_kohonen,
-                  bench_mnist_wallclock, bench_transformer,
-                  bench_pallas_parity):
+    # remaining phases, round-4 evidence first (compiled Pallas parity,
+    # flash transformer): every line above already landed, so a timeout
+    # truncates the least-critical tail
+    for phase in (bench_pallas_parity, bench_transformer, bench_cifar,
+                  bench_deconv_ae, bench_kohonen,
+                  bench_mnist_wallclock):
         try:
             phase()
         except Exception as exc:  # noqa: BLE001 — keep earlier results
