@@ -73,6 +73,13 @@ def _maybe_profile():
         yield
     finally:
         jax.profiler.stop_trace()
+        try:
+            from znicz_tpu.utils.profiling import summarize_trace
+            for r in summarize_trace(profile_dir, top=12):
+                print(f"# prof {r['total_ms']:9.2f} ms x{r['count']:<4} "
+                      f"{r['op'][:100]}", file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — summary is best-effort
+            print(f"# prof summary unavailable: {exc!r}", file=sys.stderr)
 
 
 def _throughput(step, x, labels, K: int = 8, reps: int = 3) -> float:
