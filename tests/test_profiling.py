@@ -1,0 +1,20 @@
+"""Trace comparison tooling (utils/profiling.compare_traces) — the
+evidence path for before/after kernel-level perf work."""
+
+
+def test_compare_traces(tmp_path):
+    """Two profiled runs diff at category level (envelope excluded)."""
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.utils.profiling import compare_traces
+
+    for name, n in (("a", 64), ("b", 128)):
+        d = str(tmp_path / name)
+        jax.profiler.start_trace(d)
+        x = jnp.ones((n, n))
+        (x @ x).block_until_ready()
+        jax.profiler.stop_trace()
+    rows = compare_traces(str(tmp_path / "a"), str(tmp_path / "b"))
+    assert rows and all(
+        set(r) == {"category", "a_ms", "b_ms", "delta_ms"} for r in rows)
+    assert not any(r["category"] == "while" for r in rows)

@@ -86,3 +86,37 @@ def format_summary(rows: list[dict]) -> str:
     lines += [f"{r['total_ms']:10.3f}  {r['count']:7d}  "
               f"{r['op']:<{width}}" for r in rows]
     return "\n".join(lines)
+
+
+def _category(op: str) -> str:
+    """HLO op name -> coarse category for cross-trace comparison (op
+    numbering shifts between compilations, so per-op diffs are
+    meaningless — category totals are stable)."""
+    name = op.lstrip("%")
+    for prefix in ("fusion", "copy-start", "copy-done", "slice-start",
+                   "slice-done", "copy", "convert", "convolution", "dot",
+                   "select-and-scatter", "reduce", "while", "custom-call",
+                   "add", "broadcast", "constant", "iota", "pad",
+                   "bitcast", "reshape", "dynamic"):
+        if name.startswith(prefix):
+            return prefix
+    return name.split(".")[0].split(" ")[0][:24]
+
+
+def compare_traces(logdir_a: str, logdir_b: str,
+                   top: int = 400) -> list[dict]:
+    """Category-level device-time diff of two profiled runs (A = before,
+    B = after) -> rows ``{"category", "a_ms", "b_ms", "delta_ms"}``
+    sorted by |delta|.  Envelope ``while`` rows are excluded: they cover
+    the whole step and would double-count every contained op."""
+    out: dict[str, list] = collections.defaultdict(lambda: [0.0, 0.0])
+    for i, logdir in enumerate((logdir_a, logdir_b)):
+        for r in summarize_trace(logdir, top=top):
+            cat = _category(r["op"])
+            if cat == "while":
+                continue
+            out[cat][i] += r["total_ms"]
+    rows = [{"category": k, "a_ms": round(a, 2), "b_ms": round(b, 2),
+             "delta_ms": round(b - a, 2)} for k, (a, b) in out.items()]
+    rows.sort(key=lambda r: -abs(r["delta_ms"]))
+    return rows
