@@ -415,7 +415,11 @@ def bench_kohonen(n_train=4000, minibatch=500, epochs=3):
     finally:
         root.common.engine.scan_epoch = prev_scan
     _emit("kohonen_som256_train_samples_per_sec_per_chip",
-          n_train * epochs / dt)
+          n_train * epochs / dt,
+          # 16 KB weight table, ~KB-scale per-step traffic: the SOM is
+          # dispatch-latency-bound, not MXU/HBM-bound — scan mode exists
+          # to collapse dispatches (roofline: docs/BENCH_LOG.md)
+          bound="dispatch-latency", scan_mode=True)
 
 
 def bench_mnist_wallclock(n_train=6000, n_valid=1000, target_pct=1.0,
