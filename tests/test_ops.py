@@ -103,3 +103,22 @@ def test_sgd_update_momentum_and_decay():
                             jnp.asarray(vel), 0.1, 0.1, 0.0, 0.5, 4)
     np.testing.assert_allclose(np.asarray(w2j), w2, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(vel2j), vel2, rtol=1e-6)
+
+
+def test_sgd_update_preserves_narrow_vel_dtype():
+    """The primitive's dtype contract: math in w's dtype, vel_new
+    returned in vel's storage dtype, weight apply uses the wide vel."""
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    v32 = jnp.asarray(rng.normal(size=(8, 16)) * 0.1, jnp.float32)
+    v16 = v32.astype(jnp.bfloat16)
+    args = dict(learning_rate=0.05, weights_decay=1e-3, l1_vs_l2=0.2,
+                gradient_moment=0.9, batch_size=16.0)
+    w_ref, v_ref = sgd.update(jnp, w, g, v16.astype(jnp.float32), **args)
+    w_n, v_n = sgd.update(jnp, w, g, v16, **args)
+    assert v_n.dtype == jnp.bfloat16 and w_n.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(w_n), np.asarray(w_ref))
+    np.testing.assert_array_equal(
+        np.asarray(v_n, dtype=np.float32),
+        np.asarray(v_ref.astype(jnp.bfloat16), dtype=np.float32))

@@ -42,8 +42,8 @@ def fused_sgd_update(w, grad, vel, learning_rate, weights_decay, l1_vs_l2,
         (w, grad, vel), aliases={1: 0, 3: 1}, n_out=2,
         interpret=interpret)
     if result is None:
-        w_new, vel_new = sgd_ops.update(
-            jnp, w, grad, vel.astype(w.dtype), learning_rate,
-            weights_decay, l1_vs_l2, gradient_moment, batch_size)
-        return w_new, vel_new.astype(vel.dtype)
+        # ops.sgd.update preserves vel's storage dtype itself
+        return sgd_ops.update(jnp, w, grad, vel, learning_rate,
+                              weights_decay, l1_vs_l2, gradient_moment,
+                              batch_size)
     return result

@@ -27,7 +27,15 @@ def update(xp, w, grad_sum, vel, learning_rate: float, weights_decay: float,
     ``gradient_weights`` Array with the moment folded in); pass zeros for
     the first step.  ``batch_size`` may be a traced scalar (masked tail
     minibatches divide by the *real* sample count).
+
+    Dtype contract: math runs in ``w``'s dtype (f32 masters); ``vel``
+    may be stored narrow (state_dtype bf16) — it is widened for the
+    update and ``vel_new`` is returned in ``vel``'s own dtype, so the
+    weight apply always uses the full-precision velocity.
     """
+    vel_dtype = vel.dtype
+    if vel_dtype != w.dtype:
+        vel = vel.astype(w.dtype)
     g = grad_sum / batch_size
     # branchless: hyperparams may be traced scalars inside the fused step
     # (LR schedules mutate them without recompiling); the static-zero check
@@ -36,4 +44,7 @@ def update(xp, w, grad_sum, vel, learning_rate: float, weights_decay: float,
         g = g + weights_decay * ((1.0 - l1_vs_l2) * w +
                                  l1_vs_l2 * xp.sign(w))
     vel_new = gradient_moment * vel + learning_rate * g
-    return w - vel_new, vel_new
+    w_new = w - vel_new
+    if vel_dtype != w.dtype:
+        vel_new = vel_new.astype(vel_dtype)
+    return w_new, vel_new

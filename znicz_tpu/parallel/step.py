@@ -384,13 +384,15 @@ class FusedTrainStep(Unit):
                 fwd.weights.set_devmem(leaf["w"])
             if "b" in leaf:
                 fwd.bias.set_devmem(leaf["b"])
-            widen = (lambda v: v.astype(jnp.float32)) \
-                if self.state_dtype is not None else (lambda v: v)
             if not self.shard_update:
+                # unit buffers are f32 (astype is a no-op without
+                # state_dtype; exact widening with it)
                 if "w" in leaf:
-                    gd.gradient_weights.set_devmem(widen(leaf["vw"]))
+                    gd.gradient_weights.set_devmem(
+                        leaf["vw"].astype(jnp.float32))
                 if "b" in leaf:
-                    gd.gradient_bias.set_devmem(widen(leaf["vb"]))
+                    gd.gradient_bias.set_devmem(
+                        leaf["vb"].astype(jnp.float32))
                 continue
             # sharded momenta: reassemble to the param shape host-side
             if "w" in leaf:
@@ -571,17 +573,10 @@ class FusedTrainStep(Unit):
                                    cfg["beta1"], cfg["beta2"],
                                    cfg["eps"], bsz)
 
-            if self.state_dtype is not None:
-                # narrow-storage momenta on the XLA path: f32 math,
-                # state_dtype persistence (the Pallas kernel casts
-                # in-tile itself — wrapping it here would materialize a
-                # full f32 velocity copy and defeat the single pass)
-                base_upd = upd
-
-                def upd(w, g, v, lr, wd, l1, mom, bsz, _base=base_upd):
-                    w_new, v_new = _base(w, g, v.astype(w.dtype), lr, wd,
-                                         l1, mom, bsz)
-                    return w_new, v_new.astype(self.state_dtype)
+        # narrow momenta (state_dtype) need no handling here: both
+        # backends preserve the velocity's storage dtype themselves —
+        # ops.sgd.update widens for the math and returns vel narrow; the
+        # Pallas kernel casts in-tile (single HBM pass preserved)
 
         if self.shard_update:
             from znicz_tpu.parallel import zero

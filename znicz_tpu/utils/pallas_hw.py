@@ -240,7 +240,6 @@ def run_parity(interpret: bool = False) -> dict:
         flash_attention(dtype=jnp.bfloat16, rtol=5e-2, atol=5e-2,
                         grad_rtol=1e-1, grad_atol=5e-1)
 
-
     def sgd_bf16state():
         # narrow optimizer state: velocity stored bf16, f32 math in-tile
         w = jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
