@@ -122,3 +122,47 @@ def test_sgd_update_preserves_narrow_vel_dtype():
     np.testing.assert_array_equal(
         np.asarray(v_n, dtype=np.float32),
         np.asarray(v_ref.astype(jnp.bfloat16), dtype=np.float32))
+
+
+def test_maxpool_nonoverlap_matches_select_and_scatter():
+    """The non-overlapping fast path (reshape-max forward, elementwise
+    first-winner backward) is EXACTLY the reduce_window/select-and-
+    scatter route — values and gradients, ties included — so swapping
+    implementations moves no pins."""
+    import jax
+    from jax import lax
+    from znicz_tpu.ops import pooling as P
+
+    def sas(x, k):
+        pb, pr = P._border_pad(x.shape[1], x.shape[2], k, k, k, k)
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, k, k, 1), (1, k, k, 1),
+            ((0, 0), (0, pb), (0, pr), (0, 0)))
+
+    rng = np.random.default_rng(0)
+    for shape, k in (((4, 8, 8, 3), 2), ((2, 12, 12, 5), 3),
+                     ((3, 16, 8, 4), 2)):
+        x = rng.normal(size=shape).astype(np.float32)
+        xq = np.round(x * 2) / 2          # quantized -> frequent ties
+        xq[0, :4, :4, :] = 0.5            # constant block -> full-window tie
+        for arr in (x, xq):
+            xj = jnp.asarray(arr)
+            y_new, vjp_new = jax.vjp(
+                lambda t: P._maxpool_nonoverlap(t, k, k), xj)
+            y_old, vjp_old = jax.vjp(lambda t: sas(t, k), xj)
+            np.testing.assert_array_equal(np.asarray(y_new),
+                                          np.asarray(y_old))
+            g = jnp.asarray(
+                rng.normal(size=y_new.shape).astype(np.float32))
+            np.testing.assert_array_equal(np.asarray(vjp_new(g)[0]),
+                                          np.asarray(vjp_old(g)[0]))
+    # dispatch: qualifying geometry routes to the fast path (no
+    # reduce_window in the jaxpr), non-qualifying keeps the old route
+    fast = str(jax.make_jaxpr(
+        lambda t: P.max_forward_fast(t, 2, 2, 2, 2))(
+            jnp.zeros((1, 8, 8, 2))))
+    assert "reduce_window" not in fast and "custom_vjp" in fast
+    slow = str(jax.make_jaxpr(
+        lambda t: P.max_forward_fast(t, 3, 3, 2, 2))(
+            jnp.zeros((1, 8, 8, 2))))
+    assert "reduce_window" in slow

@@ -2,57 +2,71 @@
 # Persistent chip watcher: cheap probe every 5 min; on success runs the
 # evidence sequence (compiled Pallas parity sweep, full bench, profiled
 # AlexNet/CIFAR passes), each stage in its own process with a hard
-# timeout — a mid-sequence pool wedge costs one stage, not the cycle.
-# Stops after one full successful cycle (`.scratch/cycle_done` marker).
+# timeout.  A stage timeout means `timeout` SIGTERM'd a claim-holding
+# python — that wedges the lease for a long time (docs/BENCH_LOG.md,
+# 04:18 UTC 2026-07-31 entry) — so the cycle BAILS back to the probe
+# loop instead of burning the remaining stages against a dead pool.
+# The cycle only marks itself done (`.scratch/cycle_done`) when every
+# stage ran to completion and the bench landed result lines; partial
+# evidence keeps the watcher alive for the next window.
 #
-# Start at session begin (pool access comes and goes in short windows —
-# docs/BENCH_LOG.md):   mkdir -p .scratch && nohup bash \
-#   tools/chip_watch.sh > /dev/null 2>&1 &
-# NEVER kill a process that holds the chip claim: a SIGTERM'd holder
-# wedges the lease for a long time (04:18 UTC 2026-07-31 entry).
+# Start at session begin (pool access comes and goes in short windows):
+#   nohup bash tools/chip_watch.sh > /dev/null 2>&1 &
 set -u
 cd /root/repo
+mkdir -p .scratch
 log() { echo "[$(date -u +%H:%M:%S)] $*" >> .scratch/watch.log; }
 probe() {
   timeout 150 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones(4).sum(); x.block_until_ready()
-import jax as j; print(float(x))
+print(float(x))
 " > /dev/null 2>&1
+}
+
+run_stage() {  # name timeout_s logfile python_args...
+  local name=$1 tmo=$2 logf=$3; shift 3
+  log "stage: $name"
+  timeout "$tmo" "$@" > "$logf" 2>&1
+  local rc=$?
+  log "stage $name rc=$rc"
+  return $rc
+}
+
+cycle() {
+  run_stage parity 700 .scratch/parity_r4.log \
+    python -c "
+import bench
+bench._enable_compile_cache()
+bench.bench_pallas_parity()
+" || return 1
+  run_stage bench 1700 .scratch/bench_full_r4.log \
+    python bench.py || return 1
+  grep -q '"metric"' .scratch/bench_full_r4.log || {
+    log "bench landed no result lines"; return 1; }
+  run_stage alexnet_prof 700 .scratch/alexnet_prof2_r4.log \
+    env BENCH_PROFILE=.scratch/trace_alexnet2 python -c "
+import bench
+bench._enable_compile_cache()
+bench.bench_alexnet(K=8, reps=1)
+" || return 1
+  run_stage cifar_prof 700 .scratch/cifar_prof_r4.log \
+    env BENCH_PROFILE=.scratch/trace_cifar python -c "
+import bench
+bench._enable_compile_cache()
+bench.bench_cifar(K=16, reps=1)
+" || return 1
+  return 0
 }
 
 while [ ! -f .scratch/cycle_done ]; do
   if probe; then
     log "probe OK — running evidence sequence"
-    log "stage: parity sweep"
-    timeout 700 python -c "
-import bench
-bench._enable_compile_cache()
-bench.bench_pallas_parity()
-" > .scratch/parity_r4.log 2>&1
-    log "parity rc=$?"
-    log "stage: full bench"
-    timeout 1700 python bench.py > .scratch/bench_full_r4.log 2>&1
-    log "bench rc=$?"
-    log "stage: alexnet profile"
-    timeout 700 env BENCH_PROFILE=.scratch/trace_alexnet2 python -c "
-import bench
-bench._enable_compile_cache()
-bench.bench_alexnet(K=8, reps=1)
-" > .scratch/alexnet_prof2_r4.log 2>&1
-    log "alexnet profile rc=$?"
-    log "stage: cifar profile"
-    timeout 700 env BENCH_PROFILE=.scratch/trace_cifar python -c "
-import bench
-bench._enable_compile_cache()
-bench.bench_cifar(K=16, reps=1)
-" > .scratch/cifar_prof_r4.log 2>&1
-    log "cifar profile rc=$?"
-    if grep -q '"metric"' .scratch/bench_full_r4.log; then
+    if cycle; then
       touch .scratch/cycle_done
-      log "cycle complete — results landed"
+      log "cycle complete — full evidence landed"
     else
-      log "bench produced no result lines; will retry next probe"
+      log "cycle incomplete (stage failed/timed out); back to probing"
     fi
   else
     log "probe blocked/failed; sleeping"
