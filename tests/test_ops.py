@@ -156,13 +156,53 @@ def test_maxpool_nonoverlap_matches_select_and_scatter():
                 rng.normal(size=y_new.shape).astype(np.float32))
             np.testing.assert_array_equal(np.asarray(vjp_new(g)[0]),
                                           np.asarray(vjp_old(g)[0]))
-    # dispatch: qualifying geometry routes to the fast path (no
-    # reduce_window in the jaxpr), non-qualifying keeps the old route
-    fast = str(jax.make_jaxpr(
-        lambda t: P.max_forward_fast(t, 2, 2, 2, 2))(
-            jnp.zeros((1, 8, 8, 2))))
-    assert "reduce_window" not in fast and "custom_vjp" in fast
-    slow = str(jax.make_jaxpr(
-        lambda t: P.max_forward_fast(t, 3, 3, 2, 2))(
-            jnp.zeros((1, 8, 8, 2))))
-    assert "reduce_window" in slow
+    # dispatch: every geometry routes away from reduce_window now (the
+    # non-overlap reshape path or the general strided-taps path)
+    for k, s in ((2, 2), (3, 2)):
+        jx = str(jax.make_jaxpr(
+            lambda t: P.max_forward_fast(t, k, k, s, s))(
+                jnp.zeros((1, 8, 8, 2))))
+        assert "reduce_window" not in jx and "custom_vjp" in jx, (k, s)
+
+
+def test_maxpool_taps_matches_select_and_scatter():
+    """The general strided-taps path vs the reduce_window/select-and-
+    scatter route, overlapping windows, partial borders, stride>kernel,
+    kernel>input: values EXACT; gradients route to the identical input
+    positions (support equality) with only float sum-order differences
+    where an input wins several windows (1-ULP scale)."""
+    import jax
+    from jax import lax
+    from znicz_tpu.ops import pooling as P
+
+    def sas(x, ky, kx, sy, sx):
+        pb, pr = P._border_pad(x.shape[1], x.shape[2], ky, kx, sy, sx)
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, ky, kx, 1), (1, sy, sx, 1),
+            ((0, 0), (0, pb), (0, pr), (0, 0)))
+
+    rng = np.random.default_rng(0)
+    geoms = [((2, 55, 55, 8), 3, 3, 2, 2),   # AlexNet pool, exact fit
+             ((2, 8, 8, 3), 3, 3, 2, 2),     # partial border windows
+             ((2, 9, 7, 4), 3, 2, 2, 3),     # asymmetric + partial
+             ((2, 10, 10, 2), 2, 2, 3, 3),   # stride > kernel
+             ((1, 11, 11, 1), 2, 2, 4, 4),   # stride>kernel, last window
+                                             # ends BEFORE the input
+             ((2, 5, 5, 2), 7, 7, 1, 1)]     # kernel > input
+    for shape, ky, kx, sy, sx in geoms:
+        x = rng.normal(size=shape).astype(np.float32)
+        xq = np.round(x)                     # heavy in-window ties
+        for arr in (x, xq):
+            xj = jnp.asarray(arr)
+            y_new, vjp_new = jax.vjp(
+                lambda t: P._maxpool_taps(t, ky, kx, sy, sx), xj)
+            y_old, vjp_old = jax.vjp(
+                lambda t: sas(t, ky, kx, sy, sx), xj)
+            np.testing.assert_array_equal(np.asarray(y_new),
+                                          np.asarray(y_old))
+            g = jnp.asarray(
+                rng.normal(size=y_new.shape).astype(np.float32))
+            dn = np.asarray(vjp_new(g)[0])
+            do = np.asarray(vjp_old(g)[0])
+            np.testing.assert_array_equal(dn != 0, do != 0)
+            np.testing.assert_allclose(dn, do, rtol=1e-6, atol=1e-6)

@@ -109,18 +109,96 @@ def max_forward_fast(x, ky, kx, sy, sx):
     a (n, oh, ow, ky*kx, c) gather whose argmax/take_along_axis pair
     dominated the whole AlexNet step on TPU (~50x this op).
 
-    Non-overlapping, evenly-dividing geometry (stride == kernel, H/W
-    divisible — the CIFAR k2s2 case) takes :func:`_maxpool_nonoverlap`:
-    select-and-scatter serializes badly on TPU, while the reshape-max
-    forward + elementwise first-winner backward is fully fusable.  Same
-    values, same gradients incl. tie-break (pinned by tests)."""
+    Both dispatch targets avoid select-and-scatter (TPU-hostile, and
+    ``reduce_window`` in the graph skews XLA's layout choices): the
+    non-overlapping evenly-dividing case (CIFAR k2s2) takes the
+    reshape-max :func:`_maxpool_nonoverlap`; everything else
+    (overlapping AlexNet k3s2, partial border windows, stride > kernel)
+    takes the strided-taps :func:`_maxpool_taps`.  Values and the
+    winner each gradient routes to equal the reduce_window route
+    exactly, ties included; where one input wins SEVERAL overlapping
+    windows the contributions sum in a different (fixed, deterministic)
+    order — 1-ULP-scale differences the parity test bounds."""
     if (sy, sx) == (ky, kx) and x.shape[1] % ky == 0 and \
             x.shape[2] % kx == 0:
         return _maxpool_nonoverlap(x, ky, kx)
-    pb, pr = _border_pad(x.shape[1], x.shape[2], ky, kx, sy, sx)
-    return lax.reduce_window(
-        x, -jnp.inf, lax.max, (1, ky, kx, 1), (1, sy, sx, 1),
-        ((0, 0), (0, pb), (0, pr), (0, 0)))
+    return _maxpool_taps(x, ky, kx, sy, sx)
+
+
+def _tap_geometry(h, w, ky, kx, sy, sx):
+    """Padded extent covering every (possibly partial) window: taps for
+    window offset (dy, dx) are the stride-(sy, sx) slices starting
+    there; out-of-input positions pad with -inf (never win the max,
+    and their gradient contributions are sliced away).  Clamped to at
+    least the input extent: stride > kernel can leave the last window
+    ending BEFORE the input does, and an unclamped extent would trim
+    the input (negative pad) and truncate the cotangent."""
+    oh, ow = pool_out_size(h, ky, sy), pool_out_size(w, kx, sx)
+    return oh, ow, max(h, (oh - 1) * sy + ky), max(w, (ow - 1) * sx + kx)
+
+
+def _taps(xp_pad, oh, ow, ky, kx, sy, sx):
+    """The k*k strided views, row-major window order."""
+    return [xp_pad[:, dy:dy + (oh - 1) * sy + 1:sy,
+                   dx:dx + (ow - 1) * sx + 1:sx, :]
+            for dy in range(ky) for dx in range(kx)]
+
+
+def _mpgen_pad(x, ph, pw):
+    n, h, w, c = x.shape
+    return lax.pad(x, jnp.asarray(-jnp.inf, x.dtype),
+                   ((0, 0, 0), (0, ph - h, 0), (0, pw - w, 0),
+                    (0, 0, 0)))
+
+
+def _mpgen_fwd(x, ky, kx, sy, sx):
+    n, h, w, c = x.shape
+    oh, ow, ph, pw = _tap_geometry(h, w, ky, kx, sy, sx)
+    xp_pad = _mpgen_pad(x, ph, pw)
+    taps = _taps(xp_pad, oh, ow, ky, kx, sy, sx)
+    y = taps[0]
+    for t in taps[1:]:
+        y = jnp.maximum(y, t)
+    return y, (x, y)
+
+
+def _mpgen_bwd(ky, kx, sy, sx, res, g):
+    x, y = res
+    n, h, w, c = x.shape
+    oh, ow, ph, pw = _tap_geometry(h, w, ky, kx, sy, sx)
+    xp_pad = _mpgen_pad(x, ph, pw)
+    taps = _taps(xp_pad, oh, ow, ky, kx, sy, sx)
+    zero = jnp.zeros((), g.dtype)
+    seen = jnp.zeros(y.shape, jnp.bool_)
+    dx_acc = jnp.zeros((n, ph, pw, c), g.dtype)
+    for (dy, dx), tap in zip(((dy, dx) for dy in range(ky)
+                             for dx in range(kx)), taps):
+        hit = tap == y
+        first = hit & ~seen
+        seen = seen | hit
+        contrib = jnp.where(first, g, zero)
+        # transpose of the strided slice: interior-dilated pad back to
+        # the padded input grid
+        dx_acc = dx_acc + lax.pad(
+            contrib, zero,
+            ((0, 0, 0), (dy, ph - dy - ((oh - 1) * sy + 1), sy - 1),
+             (dx, pw - dx - ((ow - 1) * sx + 1), sx - 1), (0, 0, 0)))
+    return (dx_acc[:, :h, :w, :],)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _maxpool_taps(x, ky, kx, sy, sx):
+    """Max pooling as an elementwise max over the k*k strided taps —
+    no ``reduce_window``, so the backward is first-winner masks + pads
+    instead of TPU-hostile select-and-scatter, for ANY geometry
+    (overlapping windows included).  Tie-break matches select-and-
+    scatter and the eager offset recorder (row-major window order);
+    per-window routing is exact, cross-window sums may differ from the
+    reduce_window route at 1-ULP scale (see max_forward_fast)."""
+    return _mpgen_fwd(x, ky, kx, sy, sx)[0]
+
+
+_maxpool_taps.defvjp(_mpgen_fwd, _mpgen_bwd)
 
 
 def _mpno_fwd(x, ky, kx):
