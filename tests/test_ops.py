@@ -206,3 +206,44 @@ def test_maxpool_taps_matches_select_and_scatter():
             do = np.asarray(vjp_old(g)[0])
             np.testing.assert_array_equal(dn != 0, do != 0)
             np.testing.assert_allclose(dn, do, rtol=1e-6, atol=1e-6)
+
+
+def test_maxabs_taps_matches_twin_reduce_window():
+    """maxabs via strided-taps folds + shared first-winner VJP vs the
+    old twin-reduce_window route: values exact, gradient support
+    identical (winner routing incl. branch and tie choices), magnitudes
+    within float sum-order tolerance."""
+    import jax
+    from jax import lax
+    from znicz_tpu.ops import pooling as P
+
+    def old(x, ky, kx, sy, sx):
+        pb, pr = P._border_pad(x.shape[1], x.shape[2], ky, kx, sy, sx)
+        dims, strides = (1, ky, kx, 1), (1, sy, sx, 1)
+        pad = ((0, 0), (0, pb), (0, pr), (0, 0))
+        pos = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        neg = lax.reduce_window(-x, -jnp.inf, lax.max, dims, strides,
+                                pad)
+        return jnp.where(pos >= neg, pos, -neg)
+
+    rng = np.random.default_rng(0)
+    for shape, ky, kx, sy, sx in [((2, 8, 8, 3), 3, 3, 2, 2),
+                                  ((2, 9, 7, 4), 3, 2, 2, 3),
+                                  ((1, 11, 11, 1), 2, 2, 4, 4),
+                                  ((2, 6, 6, 2), 2, 2, 2, 2),
+                                  ((2, 5, 5, 2), 7, 7, 1, 1)]:
+        x = rng.normal(size=shape).astype(np.float32)
+        xq = np.round(x)                   # ties, incl. across signs
+        for arr in (x, xq):
+            xj = jnp.asarray(arr)
+            yn, vn = jax.vjp(
+                lambda t: P.maxabs_forward_fast(t, ky, kx, sy, sx), xj)
+            yo, vo = jax.vjp(lambda t: old(t, ky, kx, sy, sx), xj)
+            np.testing.assert_array_equal(np.asarray(yn),
+                                          np.asarray(yo))
+            g = jnp.asarray(
+                rng.normal(size=yn.shape).astype(np.float32))
+            dn = np.asarray(vn(g)[0])
+            do = np.asarray(vo(g)[0])
+            np.testing.assert_array_equal(dn != 0, do != 0)
+            np.testing.assert_allclose(dn, do, rtol=1e-6, atol=1e-6)

@@ -233,17 +233,37 @@ def _maxpool_nonoverlap(x, ky, kx):
 _maxpool_nonoverlap.defvjp(_mpno_fwd, _mpno_bwd)
 
 
+def _mabs_fwd(x, ky, kx, sy, sx):
+    n, h, w, c = x.shape
+    oh, ow, ph, pw = _tap_geometry(h, w, ky, kx, sy, sx)
+    # pad each fold's operand separately: negating a shared -inf-padded
+    # input would turn border padding into +inf winners in the neg fold
+    pos = neg = None
+    for tp, tn in zip(_taps(_mpgen_pad(x, ph, pw), oh, ow, ky, kx, sy,
+                            sx),
+                      _taps(_mpgen_pad(-x, ph, pw), oh, ow, ky, kx, sy,
+                            sx)):
+        pos = tp if pos is None else jnp.maximum(pos, tp)
+        neg = tn if neg is None else jnp.maximum(neg, tn)
+    y = jnp.where(pos >= neg, pos, -neg)
+    return y, (x, y)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def maxabs_forward_fast(x, ky, kx, sy, sx):
-    """Signed winner of the max-|x| window via two max reductions:
-    ``pos = max(x)``, ``neg = max(-x)``; the winner is ``pos`` when
-    ``pos >= neg`` (largest positive dominates) else ``-neg``.  Gradient
-    flows through whichever reduction the ``where`` selects."""
-    pb, pr = _border_pad(x.shape[1], x.shape[2], ky, kx, sy, sx)
-    dims, strides = (1, ky, kx, 1), (1, sy, sx, 1)
-    pad = ((0, 0), (0, pb), (0, pr), (0, 0))
-    pos = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
-    neg = lax.reduce_window(-x, -jnp.inf, lax.max, dims, strides, pad)
-    return jnp.where(pos >= neg, pos, -neg)
+    """Signed winner of the max-|x| window via two strided-taps max
+    folds (same no-reduce_window rationale as :func:`_maxpool_taps`):
+    ``pos = max(x)``, ``neg = max(-x)``, ``y = pos if pos >= neg else
+    -neg``.  In BOTH branches ``y`` equals the winning tap's value, so
+    the backward is :func:`_mpgen_bwd` unchanged — first row-major tap
+    with ``t == y`` gets the gradient, which reproduces the old
+    twin-reduce_window route's select-and-scatter winner exactly (a
+    custom VJP because ``jnp.maximum`` SPLITS gradient on in-fold ties
+    instead of first-match)."""
+    return _mabs_fwd(x, ky, kx, sy, sx)[0]
+
+
+maxabs_forward_fast.defvjp(_mabs_fwd, _mpgen_bwd)
 
 
 def avg_forward_fast(x, ky, kx, sy, sx):
