@@ -252,3 +252,52 @@ def test_activation_backward_matches_central_differences(name, seed):
     fm = (activations.forward(np, name, v - eps * dd) * e).sum()
     np.testing.assert_allclose((grad * dd).sum(), (fp - fm) / (2 * eps),
                                rtol=2e-4, atol=1e-6, err_msg=name)
+
+
+@st.composite
+def pool_fuzz_cases(draw):
+    ky = draw(st.integers(1, 4))
+    kx = draw(st.integers(1, 4))
+    sy = draw(st.integers(1, 4))
+    sx = draw(st.integers(1, 4))
+    h = draw(st.integers(max(ky, sy), 12))
+    w = draw(st.integers(max(kx, sx), 12))
+    n = draw(st.integers(1, 2))
+    c = draw(st.integers(1, 3))
+    quantize = draw(st.booleans())
+    seed = draw(st.integers(0, 2 ** 31 - 1))
+    return n, h, w, c, ky, kx, sy, sx, quantize, seed
+
+
+@given(pool_fuzz_cases())
+@settings(**SETTINGS)
+def test_maxpool_fast_paths_match_reduce_window_fuzz(case):
+    """Random geometry fuzz for the no-select-and-scatter max-pool paths
+    (reshape + strided-taps dispatch): values exact vs reduce_window,
+    gradient support identical, magnitudes within sum-order tolerance."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, h, w, c, ky, kx, sy, sx, quantize, seed = case
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c)).astype(np.float32)
+    if quantize:
+        x = np.round(x)
+    xj = jnp.asarray(x)
+
+    def ref(t):
+        pb, pr = pooling._border_pad(h, w, ky, kx, sy, sx)
+        return lax.reduce_window(
+            t, -jnp.inf, lax.max, (1, ky, kx, 1), (1, sy, sx, 1),
+            ((0, 0), (0, pb), (0, pr), (0, 0)))
+
+    y_new, vjp_new = jax.vjp(
+        lambda t: pooling.max_forward_fast(t, ky, kx, sy, sx), xj)
+    y_old, vjp_old = jax.vjp(ref, xj)
+    np.testing.assert_array_equal(np.asarray(y_new), np.asarray(y_old))
+    g = jnp.asarray(rng.normal(size=y_new.shape).astype(np.float32))
+    dn = np.asarray(vjp_new(g)[0])
+    do = np.asarray(vjp_old(g)[0])
+    np.testing.assert_array_equal(dn != 0, do != 0)
+    np.testing.assert_allclose(dn, do, rtol=1e-6, atol=1e-6)
