@@ -314,3 +314,38 @@ def test_avg_pool_fast_grad_under_shard_map(cpu_devices):
     assert g.shape == x.shape
     np.testing.assert_allclose(np.asarray(g),
                                np.full(x.shape, 4 * 0.25), rtol=1e-6)
+
+
+def test_stochastic_fast_path_matches_ad_route():
+    """stochastic_forward_fast (masks + dilated pads backward) vs AD
+    through the patch/take_along_axis route: same sampled winners, same
+    values, gradient support identical, magnitudes within sum-order
+    tolerance; uniform's cotangent is zero."""
+    import jax
+
+    rng = np.random.default_rng(7)
+    for shape, ky, kx, sy, sx in [((2, 8, 8, 3), 3, 3, 2, 2),
+                                  ((2, 6, 6, 2), 2, 2, 2, 2),
+                                  ((1, 11, 11, 1), 2, 2, 4, 4)]:
+        for use_abs in (False, True):
+            x = rng.normal(size=shape).astype(np.float32)
+            oh = pool_ops.pool_out_size(shape[1], ky, sy)
+            ow = pool_ops.pool_out_size(shape[2], kx, sx)
+            u = rng.uniform(size=(shape[0], oh, ow, shape[3])) \
+                .astype(np.float32)
+            xj, uj = jnp.asarray(x), jnp.asarray(u)
+            yn, vjp_new = jax.vjp(
+                lambda t, uu: pool_ops.stochastic_forward_fast(
+                    t, uu, ky, kx, sy, sx, use_abs), xj, uj)
+            yo, vjp_old = jax.vjp(
+                lambda t: pool_ops.stochastic_forward(
+                    jnp, t, ky, kx, sy, sx, uj, use_abs, True)[0], xj)
+            np.testing.assert_array_equal(np.asarray(yn),
+                                          np.asarray(yo))
+            g = jnp.asarray(rng.normal(size=yn.shape).astype(np.float32))
+            dn, du = vjp_new(g)
+            do, = vjp_old(g)
+            dn, do = np.asarray(dn), np.asarray(do)
+            np.testing.assert_array_equal(dn != 0, do != 0)
+            np.testing.assert_allclose(dn, do, rtol=1e-6, atol=1e-6)
+            assert not np.asarray(du).any()

@@ -162,6 +162,17 @@ def _mpgen_fwd(x, ky, kx, sy, sx):
     return y, (x, y)
 
 
+def _tap_transpose_pad(contrib, zero, dy, dx, geom):
+    """Transpose of the (dy, dx) strided tap slice: interior-dilated pad
+    back onto the padded input grid.  THE one copy of the pad config —
+    shared by every taps-path backward."""
+    oh, ow, ph, pw, sy, sx = geom
+    return lax.pad(
+        contrib, zero,
+        ((0, 0, 0), (dy, ph - dy - ((oh - 1) * sy + 1), sy - 1),
+         (dx, pw - dx - ((ow - 1) * sx + 1), sx - 1), (0, 0, 0)))
+
+
 def _mpgen_bwd(ky, kx, sy, sx, res, g):
     x, y = res
     n, h, w, c = x.shape
@@ -177,12 +188,8 @@ def _mpgen_bwd(ky, kx, sy, sx, res, g):
         first = hit & ~seen
         seen = seen | hit
         contrib = jnp.where(first, g, zero)
-        # transpose of the strided slice: interior-dilated pad back to
-        # the padded input grid
-        dx_acc = dx_acc + lax.pad(
-            contrib, zero,
-            ((0, 0, 0), (dy, ph - dy - ((oh - 1) * sy + 1), sy - 1),
-             (dx, pw - dx - ((ow - 1) * sx + 1), sx - 1), (0, 0, 0)))
+        dx_acc = dx_acc + _tap_transpose_pad(contrib, zero, dy, dx,
+                                             (oh, ow, ph, pw, sy, sx))
     return (dx_acc[:, :h, :w, :],)
 
 
@@ -296,29 +303,83 @@ def avg_forward(xp, x, ky, kx, sy, sx):
     return patch.sum(axis=3) / xp.asarray(count[None].astype(np.float32))
 
 
+def _stochastic_probs(xp, x, ky, kx, sy, sx, use_abs: bool):
+    """``(patch, p, total)`` — the (abs-)activation window probabilities
+    shared by train sampling and eval expectation."""
+    patch, valid, _ = patches(xp, x, ky, kx, sy, sx, pad_value=0.0)
+    vmask = valid[None, :, :, :, None]
+    p = xp.abs(patch) if use_abs else xp.maximum(patch, 0.0)
+    p = xp.where(vmask, p, 0.0)
+    return patch, p, p.sum(axis=3, keepdims=True)
+
+
+def _stochastic_choice(xp, x, ky, kx, sy, sx, uniform, use_abs: bool):
+    """Inverse-CDF winner per window -> ``(patch, idx)``.  STRICT
+    compare: a zero-total window (all probabilities 0, u = 0) selects
+    element 0, which is always in-bounds — the window origin is a real
+    input cell."""
+    patch, p, total = _stochastic_probs(xp, x, ky, kx, sy, sx, use_abs)
+    cdf = xp.cumsum(p, axis=3)
+    u = uniform[:, :, :, None, :] * total
+    idx = (cdf < u).sum(axis=3)
+    return patch, xp.minimum(idx, ky * kx - 1)
+
+
 def stochastic_forward(xp, x, ky, kx, sy, sx, uniform, use_abs: bool,
                        train: bool):
     """Zeiler&Fergus stochastic pooling.  ``uniform`` is (n, oh, ow, c) in
     [0, 1) from the framework PRNG (host xorshift for numpy, counter-based
     jax PRNG on device).  Returns ``(y, offsets)`` when training, else
     ``(expectation, None)``."""
-    patch, valid, _ = patches(xp, x, ky, kx, sy, sx, pad_value=0.0)
-    vmask = valid[None, :, :, :, None]
-    p = xp.abs(patch) if use_abs else xp.maximum(patch, 0.0)
-    p = xp.where(vmask, p, 0.0)
-    total = p.sum(axis=3, keepdims=True)
     if not train:
+        patch, p, total = _stochastic_probs(xp, x, ky, kx, sy, sx,
+                                            use_abs)
         w = xp.where(total > 0, p / xp.where(total > 0, total, 1.0), 0.0)
         return (patch * w).sum(axis=3), None
-    # inverse-CDF sampling with STRICT compare: a zero-total window (all
-    # probabilities 0, u = 0) then selects element 0, which is always
-    # in-bounds — the window origin is a real input cell
-    cdf = xp.cumsum(p, axis=3)
-    u = uniform[:, :, :, None, :] * total
-    idx = (cdf < u).sum(axis=3)
-    idx = xp.minimum(idx, ky * kx - 1)
+    patch, idx = _stochastic_choice(xp, x, ky, kx, sy, sx, uniform,
+                                    use_abs)
     y = xp.take_along_axis(patch, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
     return y, offsets_of(xp, idx, x.shape, ky, kx, sy, sx)
+
+
+def _stoch_fwd(x, uniform, ky, kx, sy, sx, use_abs):
+    patch, idx = _stochastic_choice(jnp, x, ky, kx, sy, sx, uniform,
+                                    use_abs)
+    y = jnp.take_along_axis(
+        patch, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    return y, (x, idx)
+
+
+def _stoch_bwd(ky, kx, sy, sx, use_abs, res, g):  # nondiff args lead
+    x, idx = res            # x rides for shape/dtype only
+    n, h, w, c = x.shape
+    oh, ow, ph, pw = _tap_geometry(h, w, ky, kx, sy, sx)
+    zero = jnp.zeros((), g.dtype)
+    dx_acc = jnp.zeros((n, ph, pw, c), g.dtype)
+    for t, (dy, dx) in enumerate((dy, dx) for dy in range(ky)
+                                 for dx in range(kx)):
+        contrib = jnp.where(idx == t, g, zero)
+        dx_acc = dx_acc + _tap_transpose_pad(contrib, zero, dy, dx,
+                                             (oh, ow, ph, pw, sy, sx))
+    # uniform's cotangent is structurally zero (idx is integer-valued)
+    return (dx_acc[:, :h, :w, :].astype(x.dtype),
+            jnp.zeros(g.shape, g.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def stochastic_forward_fast(x, uniform, ky, kx, sy, sx, use_abs):
+    """Train-mode stochastic pooling whose backward routes the gradient
+    to the sampled winner with masks + interior-dilated pads instead of
+    AD's scatter through ``take_along_axis`` (the same
+    no-select-and-scatter rationale as :func:`_maxpool_taps`; the
+    sampled index IS the routing, so no value matching is needed).
+    Gradient equals the AD route exactly: only the chosen patch position
+    receives cotangent (``idx`` is integer — nothing flows through the
+    probability computation)."""
+    return _stoch_fwd(x, uniform, ky, kx, sy, sx, use_abs)[0]
+
+
+stochastic_forward_fast.defvjp(_stoch_fwd, _stoch_bwd)
 
 
 def scatter_backward(xp, err_output, offsets, in_shape):

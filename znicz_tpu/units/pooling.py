@@ -155,10 +155,8 @@ class StochasticPooling(OffsetPooling):
         out_shape = self.output_shape_for(x.shape)
         if train:
             u = jax.random.uniform(rng, out_shape)
-            y, _ = pool_ops.stochastic_forward(
-                jnp, x, self.ky, self.kx, self.sy, self.sx, u,
-                self.USE_ABS, train=True)
-            return y
+            return pool_ops.stochastic_forward_fast(
+                x, u, self.ky, self.kx, self.sy, self.sx, self.USE_ABS)
         y, _ = pool_ops.stochastic_forward(
             jnp, x, self.ky, self.kx, self.sy, self.sx, None,
             self.USE_ABS, train=False)
