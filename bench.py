@@ -206,9 +206,12 @@ def bench_alexnet(batch=128, K=8, reps=3):
     prng.seed_all(7)
     # loader dataset is minimal (8 samples): the bench stages its own
     # device-resident batches below; the loader only satisfies initialize()
+    # bf16 momentum storage: the 62M-param SGD update moves ~1.2 GB/step
+    # of f32 state; the narrow velocity halves its share (docs/TUNING.md)
     w = build(max_epochs=1, minibatch_size=batch, n_classes=1000,
               input_size=227, n_train=8, n_valid=0,
-              loader_config={"n_classes": 8})
+              loader_config={"n_classes": 8},
+              optimizer_config={"state_dtype": "bfloat16"})
     w.initialize(device=TPUDevice())
     print(f"# alexnet: initialized in {time.time() - t0:.1f}s",
           file=sys.stderr)
@@ -217,7 +220,7 @@ def bench_alexnet(batch=128, K=8, reps=3):
     labels = rng.integers(0, 1000, batch).astype(np.int32)
     sps = _throughput(w.step, x, labels, K, reps)
     return _emit("alexnet_b128_train_samples_per_sec_per_chip", sps,
-                 w.forwards, batch)
+                 w.forwards, batch, state_dtype="bfloat16")
 
 
 def bench_cifar(batch=512, K=16, reps=3):

@@ -61,7 +61,8 @@ def build(max_epochs: int = 1, minibatch_size: int = 128,
           dropout: float = 0.5, fused: bool = True, mesh=None,
           loader_name: str = "synthetic_image",
           loader_config: dict | None = None,
-          snapshotter_config: dict | None = None) -> StandardWorkflow:
+          snapshotter_config: dict | None = None,
+          optimizer_config: dict | None = None) -> StandardWorkflow:
     """``loader_name="file_image"`` + ``loader_config={"data_dir": ...}``
     streams a directory-per-class ImageNet-style tree with fitted
     mean_disp normalization (the real-data path); add ``"augment": True``
@@ -97,7 +98,8 @@ def build(max_epochs: int = 1, minibatch_size: int = 128,
         loss_function="softmax", loader_name=loader_name,
         loader_config=cfg,
         decision_config={"max_epochs": max_epochs},
-        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh)
+        snapshotter_config=snapshotter_config, fused=fused, mesh=mesh,
+        optimizer_config=optimizer_config)
 
 
 def run(load, main):
