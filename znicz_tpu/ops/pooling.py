@@ -17,10 +17,13 @@ Semantics kept from the reference:
   (inference) they fall back to the probability-weighted expectation.
 
 One implementation serves both backends: the patch tensor is built by a
-static python loop over the window (numpy slices / XLA-fused slices).  The
-fused training path differentiates through the jnp forward with autograd,
-so the recorded offsets are only used by the eager per-unit backward —
-exactly the role the reference's ``input_offset`` plays.
+static python loop over the window (numpy slices / XLA-fused slices).
+The recorded offsets are only used by the eager per-unit backward —
+exactly the role the reference's ``input_offset`` plays.  The fused
+training path's backwards are custom VJPs (first-winner masks +
+interior-dilated pads over the strided taps) so no pooling gradient
+lowers to select-and-scatter or scatter-add on TPU; each is pinned
+against the XLA-native route it replaced (docs/TUNING.md).
 """
 
 from __future__ import annotations
