@@ -63,7 +63,14 @@ bench.bench_cifar(K=16, reps=1)
   return 0
 }
 
+# Optional WATCH_DEADLINE_EPOCH (unix seconds): exit before the driver's
+# round-end bench so a watcher stage never holds the chip against it.
 while [ ! -f .scratch/cycle_done ]; do
+  if [ -n "${WATCH_DEADLINE_EPOCH:-}" ] && \
+     [ "$(date +%s)" -ge "$WATCH_DEADLINE_EPOCH" ]; then
+    log "deadline reached — exiting to leave the chip to the driver"
+    break
+  fi
   if probe; then
     log "probe OK — running evidence sequence"
     if cycle; then
