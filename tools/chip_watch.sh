@@ -1,20 +1,25 @@
 #!/bin/bash
-# Persistent chip watcher: cheap probe every 5 min; on success runs the
-# evidence sequence (compiled Pallas parity sweep, full bench, profiled
-# AlexNet/CIFAR passes), each stage in its own process with a hard
-# timeout.  A stage timeout means `timeout` SIGTERM'd a claim-holding
-# python — that wedges the lease for a long time (docs/BENCH_LOG.md,
-# 04:18 UTC 2026-07-31 entry) — so the cycle BAILS back to the probe
-# loop instead of burning the remaining stages against a dead pool.
-# The cycle only marks itself done (`.scratch/cycle_done`) when every
-# stage ran to completion and the bench landed result lines; partial
-# evidence keeps the watcher alive for the next window.
+# Persistent chip watcher (round 5): cheap probe every 3 min; on success
+# runs the evidence sequence (compiled Pallas parity sweep, full bench,
+# profiled AlexNet/CIFAR/transformer passes), each stage in its own
+# process with a hard timeout.  A stage timeout means `timeout`
+# SIGTERM'd a claim-holding python — that wedges the lease for a long
+# time (docs/BENCH_LOG.md, 04:18 UTC 2026-07-31 entry) — so the cycle
+# BAILS back to the probe loop instead of burning the remaining stages
+# against a dead pool.
+#
+# Round-5 lesson from the r4 verdict: evidence must land in a TRACKED
+# artifact.  So after every cycle — complete or not — whatever stage
+# logs exist are exported to docs/bench_hw_r5_watcher.jsonl and that one
+# file is committed (path-scoped commit; retries around transient index
+# locks).  Partial windows still make history.
 #
 # Start at session begin (pool access comes and goes in short windows):
 #   nohup bash tools/chip_watch.sh > /dev/null 2>&1 &
 set -u
 cd /root/repo
 mkdir -p .scratch
+EVIDENCE=docs/bench_hw_r5_watcher.jsonl
 log() { echo "[$(date -u +%H:%M:%S)] $*" >> .scratch/watch.log; }
 probe() {
   timeout 150 python -c "
@@ -24,8 +29,20 @@ print(float(x))
 " > /dev/null 2>&1
 }
 
+past_deadline() {
+  [ -n "${WATCH_DEADLINE_EPOCH:-}" ] && \
+    [ "$(date +%s)" -ge "$WATCH_DEADLINE_EPOCH" ]
+}
+
 run_stage() {  # name timeout_s logfile python_args...
   local name=$1 tmo=$2 logf=$3; shift 3
+  # re-check the deadline before EVERY stage: a cycle started just
+  # before the deadline must not hold the chip ~90 min into the
+  # driver's round-end bench
+  if past_deadline; then
+    log "deadline reached mid-cycle — skipping stage $name"
+    return 1
+  fi
   log "stage: $name"
   timeout "$tmo" "$@" > "$logf" 2>&1
   local rc=$?
@@ -33,39 +50,75 @@ run_stage() {  # name timeout_s logfile python_args...
   return $rc
 }
 
+export_evidence() {
+  # APPEND a per-cycle section (never truncate): if a partial export's
+  # commit failed, the next window must not destroy the previous
+  # window's only copy of its evidence
+  {
+    echo "# chip_watch r5 evidence export $(date -u +%FT%TZ) (cycle status: $1)"
+    for f in parity bench_full alexnet_prof cifar_prof transformer_prof; do
+      [ -f ".scratch/${f}_r5.log" ] || continue
+      echo "# --- stage: $f (log mtime $(date -u -r ".scratch/${f}_r5.log" +%FT%TZ)) ---"
+      grep -a "pallas_hw_parity\|\"metric\"\|# prof\|FAIL\|attention" \
+        ".scratch/${f}_r5.log"
+    done
+  } >> "$EVIDENCE" 2>&1
+  for i in 1 2 3 4 5 6 7 8 9 10; do
+    if git add "$EVIDENCE" >> .scratch/watch.log 2>&1 && \
+       git commit -q -m "Watcher: hardware evidence export ($1)" \
+         -- "$EVIDENCE" >> .scratch/watch.log 2>&1; then
+      log "evidence committed"; return
+    fi
+    log "commit attempt $i failed (stderr above)"
+    sleep 20
+  done
+  log "evidence export written but commit failed (left for round-end)"
+}
+
 cycle() {
-  run_stage parity 700 .scratch/parity_r4.log \
+  # fresh stage logs: export_evidence must never re-attribute a previous
+  # window's logs to this cycle
+  rm -f .scratch/parity_r5.log .scratch/bench_full_r5.log \
+        .scratch/alexnet_prof_r5.log .scratch/cifar_prof_r5.log \
+        .scratch/transformer_prof_r5.log
+  run_stage parity 900 .scratch/parity_r5.log \
     python -c "
 import bench
 bench._enable_compile_cache()
 bench.bench_pallas_parity()
 " || return 1
-  # raised child budget: this session changed every compiled program, so
-  # the first hardware run pays ~20-40 s remote-compile per phase; the
-  # driver's later default-budget run reuses the cache this run warms
-  run_stage bench 2400 .scratch/bench_full_r4.log \
+  # raised child budget: first hardware run of changed programs pays
+  # ~20-40 s remote-compile per phase; the driver's later default-budget
+  # run reuses the cache this run warms
+  run_stage bench_full 2400 .scratch/bench_full_r5.log \
     env BENCH_TPU_TIMEOUT=1500 BENCH_TPU_RETRY_TIMEOUT=600 \
     python bench.py || return 1
-  grep -q '"metric"' .scratch/bench_full_r4.log || {
+  grep -q '"metric"' .scratch/bench_full_r5.log || {
     log "bench landed no result lines"; return 1; }
-  run_stage alexnet_prof 700 .scratch/alexnet_prof2_r4.log \
-    env BENCH_PROFILE=.scratch/trace_alexnet2 python -c "
+  run_stage alexnet_prof 700 .scratch/alexnet_prof_r5.log \
+    env BENCH_PROFILE=.scratch/trace_alexnet_r5 python -c "
 import bench
 bench._enable_compile_cache()
 bench.bench_alexnet(K=8, reps=1)
 " || return 1
-  run_stage cifar_prof 700 .scratch/cifar_prof_r4.log \
-    env BENCH_PROFILE=.scratch/trace_cifar python -c "
+  run_stage cifar_prof 700 .scratch/cifar_prof_r5.log \
+    env BENCH_PROFILE=.scratch/trace_cifar_r5 python -c "
 import bench
 bench._enable_compile_cache()
 bench.bench_cifar(K=16, reps=1)
+" || return 1
+  run_stage transformer_prof 900 .scratch/transformer_prof_r5.log \
+    env BENCH_PROFILE=.scratch/trace_transformer_r5 python -c "
+import bench
+bench._enable_compile_cache()
+bench.bench_transformer(K=4, reps=1)
 " || return 1
   return 0
 }
 
 # Optional WATCH_DEADLINE_EPOCH (unix seconds): exit before the driver's
 # round-end bench so a watcher stage never holds the chip against it.
-while [ ! -f .scratch/cycle_done ]; do
+while [ ! -f .scratch/cycle_done_r5 ]; do
   if [ -n "${WATCH_DEADLINE_EPOCH:-}" ] && \
      [ "$(date +%s)" -ge "$WATCH_DEADLINE_EPOCH" ]; then
     log "deadline reached — exiting to leave the chip to the driver"
@@ -74,26 +127,15 @@ while [ ! -f .scratch/cycle_done ]; do
   if probe; then
     log "probe OK — running evidence sequence"
     if cycle; then
-      touch .scratch/cycle_done
-      # .scratch/ is gitignored: export the evidence somewhere tracked so
-      # a round-end commit (driver or next session) preserves it
-      {
-        echo "# chip_watch evidence cycle completed $(date -u +%FT%TZ)"
-        echo "# parity sweep:"
-        grep -a "pallas_hw_parity\|\"metric\"" .scratch/parity_r4.log
-        echo "# full bench result lines:"
-        grep -a '"metric"' .scratch/bench_full_r4.log
-        echo "# profiled AlexNet top ops:"
-        grep -a "# prof" .scratch/alexnet_prof2_r4.log
-        echo "# profiled CIFAR top ops:"
-        grep -a "# prof" .scratch/cifar_prof_r4.log
-      } > docs/bench_hw_r4_watcher.jsonl 2>&1
-      log "cycle complete — full evidence landed (exported to docs/)"
+      touch .scratch/cycle_done_r5
+      export_evidence complete
+      log "cycle complete — full evidence landed + committed"
     else
-      log "cycle incomplete (stage failed/timed out); back to probing"
+      export_evidence partial
+      log "cycle incomplete (stage failed/timed out); partial evidence exported; back to probing"
     fi
   else
     log "probe blocked/failed; sleeping"
   fi
-  sleep 300
+  sleep 180
 done

@@ -364,9 +364,10 @@ def _stoch_bwd(ky, kx, sy, sx, use_abs, res, g):  # nondiff args lead
         contrib = jnp.where(idx == t, g, zero)
         dx_acc = dx_acc + _tap_transpose_pad(contrib, zero, dy, dx,
                                              (oh, ow, ph, pw, sy, sx))
-    # uniform's cotangent is structurally zero (idx is integer-valued)
-    return (dx_acc[:, :h, :w, :].astype(x.dtype),
-            jnp.zeros(g.shape, g.dtype))
+    # uniform's cotangent is structurally zero (idx is integer-valued);
+    # None is custom_vjp's symbolic zero and stays correct when uniform's
+    # dtype (f32) differs from a mixed-precision cotangent g (bf16)
+    return (dx_acc[:, :h, :w, :].astype(x.dtype), None)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))

@@ -32,10 +32,10 @@ def _newest_run_files(logdir: str) -> list[str]:
     return sorted(by_run[newest])
 
 
-def summarize_trace(logdir: str, top: int = 25) -> list[dict]:
+def summarize_trace(logdir: str, top: int | None = 25) -> list[dict]:
     """-> rows ``{"op", "total_ms", "count"}`` sorted by total device
     time, aggregated over all hosts/devices of the newest trace run
-    under ``logdir``."""
+    under ``logdir``.  ``top=None`` returns the full untruncated list."""
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
     except ImportError:                        # pragma: no cover
@@ -73,7 +73,7 @@ def summarize_trace(logdir: str, top: int = 25) -> list[dict]:
     rows = [{"op": op, "total_ms": ps / 1e9, "count": count}
             for op, (ps, count) in agg.items()]
     rows.sort(key=lambda r: -r["total_ms"])
-    return rows[:top]
+    return rows if top is None else rows[:top]
 
 
 def format_summary(rows: list[dict]) -> str:
@@ -104,11 +104,14 @@ def _category(op: str) -> str:
 
 
 def compare_traces(logdir_a: str, logdir_b: str,
-                   top: int = 400) -> list[dict]:
+                   top: int | None = None) -> list[dict]:
     """Category-level device-time diff of two profiled runs (A = before,
     B = after) -> rows ``{"category", "a_ms", "b_ms", "delta_ms"}``
     sorted by |delta|.  Envelope ``while`` rows are excluded: they cover
-    the whole step and would double-count every contained op."""
+    the whole step and would double-count every contained op.  Category
+    totals aggregate the FULL op list by default — truncating per-trace
+    at top-N would show spurious deltas for categories whose ops fall
+    below the cutoff in one trace only."""
     out: dict[str, list] = collections.defaultdict(lambda: [0.0, 0.0])
     for i, logdir in enumerate((logdir_a, logdir_b)):
         for r in summarize_trace(logdir, top=top):
