@@ -172,7 +172,11 @@ def _emit(metric: str, value: float, forwards=None, batch: int = 0,
 # child: claims the device once, benches cheapest-first, flushes each line
 # ---------------------------------------------------------------------------
 
-def bench_fc(batch=1024, layers=(4096, 4096), K=64, reps=3):
+def bench_fc(batch=1024, layers=(4096, 4096), K=256, reps=3):
+    # K=256: the r4 FC trace (docs/TRACE_R4.md) measured 0.38 ms/step of
+    # per-dispatch overhead at K=64 — 33% of the 1.165 ms wall step;
+    # K=256 cuts it to ~0.09 ms (staging 256×3 MB ≈ 820 MB, well inside
+    # HBM)
     import numpy as np
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
@@ -196,7 +200,9 @@ def bench_fc(batch=1024, layers=(4096, 4096), K=64, reps=3):
           w.forwards, batch, state_dtype="bfloat16")
 
 
-def bench_alexnet(batch=128, K=8, reps=3):
+def bench_alexnet(batch=128, K=16, reps=3):
+    # K=16: ~3 ms/step of dispatch overhead at K=8 (18% of wall,
+    # docs/TRACE_R4.md) halves; staging 16×79 MB ≈ 1.3 GB
     import numpy as np
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
@@ -223,19 +229,24 @@ def bench_alexnet(batch=128, K=8, reps=3):
                  w.forwards, batch, state_dtype="bfloat16")
 
 
-def bench_cifar(batch=512, K=16, reps=3):
+def bench_cifar(batch=512, K=64, reps=3):
     """BASELINE.md config 2: CIFAR-10 ConvRELU + MaxPooling + GDConv.
 
-    Two batch sizes: b512 is the cross-round continuity config; at its
-    ~2 ms step the per-step fixed costs (small-tensor updates, layout
-    moves) dominate, so a 4x batch shows what the conv path sustains
-    when the MXU work amortizes them."""
+    Two batch sizes: b512 is the cross-round continuity config; the r4
+    trace (docs/TRACE_R4.md) showed ~65% of its wall step was
+    per-dispatch overhead (32 tiny param/momentum copies + dispatch
+    latency), so K rises 16→64 to amortize it; the 4x batch line shows
+    what the conv path sustains when the MXU work amortizes the
+    elementwise soup (K=16 there keeps staging at ~400 MB)."""
     import numpy as np
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
     from znicz_tpu.models.cifar_conv import build
 
     for b, k in ((batch, K), (4 * batch, max(K // 4, 2))):
+        # (b512, K=64) and (b2048, K=16): equal samples per dispatch,
+        # so the fixed cost amortizes identically and the A/B isolates
+        # the per-sample compute efficiency
         t0 = time.time()
         prng.seed_all(7)
         w = build(max_epochs=1, minibatch_size=b, n_train=b, n_valid=0,
@@ -252,7 +263,9 @@ def bench_cifar(batch=512, K=16, reps=3):
               w.forwards, b)
 
 
-def bench_deconv_ae(batch=64, K=8, reps=3):
+def bench_deconv_ae(batch=64, K=64, reps=3):
+    # K=64: the deconv step is 0.45 ms in-loop (docs/TRACE_R4.md);
+    # dispatch overhead dominates at K=8; staging 64×3 MB ≈ 200 MB
     """BASELINE.md config 4 at ImagenetAE-representative scale: 64x64x3
     input, 64/128-kernel strided conv encoder, mirrored deconv decoder.
     (The r1-r3 32x32x1/32-kernel toy measured model smallness, not the
