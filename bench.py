@@ -95,11 +95,16 @@ def _throughput(step, x, labels, K: int = 8, reps: int = 3) -> float:
     import numpy as np
 
     batch = x.shape[0]
-    xs = jnp.asarray(np.stack([np.roll(x, k, axis=0) for k in range(K)]))
-    # roll on the batch axis only — labels may be image targets (MSE)
-    ys = jnp.asarray(np.stack([np.roll(labels, k, axis=0)
-                               for k in range(K)]))
-    ms = jnp.ones((K, batch), bool)
+    # one h2d of the base batch; the K rolled copies are built ON DEVICE
+    # by a gather (np.roll(x, k)[i] == x[(i-k) % batch]) — at the r5 K
+    # values host-side np.stack would peak at ~1.6 GB and push ~1 GB
+    # through the TPU tunnel before timing starts
+    xd, yd = jnp.asarray(x), jnp.asarray(labels)
+    idx = jnp.asarray((np.arange(batch)[None, :] -
+                       np.arange(K)[:, None]) % batch)
+    xs = xd[idx]                          # (K, batch, ...)
+    ys = yd[idx]                          # roll on the batch axis only —
+    ms = jnp.ones((K, batch), bool)       # labels may be image targets
     jax.device_get(xs[0, 0, 0])          # fence the staging transfers
 
     metrics = step.train_steps(xs, ys, ms)      # compile + warm
