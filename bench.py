@@ -323,8 +323,13 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
         prng.seed_all(7)
         params = tfm.init_params(prng.get(), n_layers, d, heads, 4 * d,
                                  vocab)
+        # loss_chunks=16: the (16384, 32000) f32 logits are ~2 GB and
+        # the CE stack multiplies that through log_softmax + AD
+        # residuals — chunked remat keeps one 1024-token chunk live
+        # (docs/TUNING.md)
         step, _ = tfm.make_train_step(mesh, n_layers, d, heads, 4 * d,
-                                      vocab, lr=1e-3, donate=True)
+                                      vocab, lr=1e-3, donate=True,
+                                      loss_chunks=16)
         params, loss = step(params, tokens, labels)   # compile + warm
         float(jax.device_get(loss))
     except Exception as exc:  # noqa: BLE001 — flash may not lower here
@@ -339,7 +344,7 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
                                      4 * d, vocab)
             step, _ = tfm.make_train_step(mesh, n_layers, d, heads,
                                           4 * d, vocab, lr=1e-3,
-                                          donate=True)
+                                          donate=True, loss_chunks=16)
             params, loss = step(params, tokens, labels)
             float(jax.device_get(loss))
         finally:

@@ -295,3 +295,50 @@ def test_remat_and_donate_match_baseline(cpu_devices):
     for name in ("remat", "donate"):
         assert outs[name][0] == outs["plain"][0], (name, outs[name][0])
         np.testing.assert_array_equal(outs[name][1], outs["plain"][1])
+
+
+def test_chunked_ce_matches_dense(cpu_devices):
+    """loss_chunks=k computes the same loss/updated params as the dense
+    CE path up to summation order (the (tokens, vocab) logits are never
+    materialized — docs/TUNING.md); covers unmasked AND masked variants,
+    including a token count that does not divide the chunk count (the
+    zero-weight padding tail), on the full dp x sp x tp mesh."""
+    import jax
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    n_layers, d, heads, ff, vocab = 2, 32, 4, 64, 13
+    rng = np.random.default_rng(6)
+    tokens = rng.integers(0, vocab, (4, 16)).astype(np.int32)
+    labels = ((tokens + 1) % vocab).astype(np.int32)
+    mask = np.array([True, True, True, False])
+
+    for masked in (False, True):
+        outs = {}
+        for name, chunks in (("dense", None), ("chunk4", 4),
+                             ("chunk3", 3)):   # 3 does not divide 16·2
+            prng.seed_all(11)
+            params = tfm.init_params(prng.get(), n_layers, d, heads, ff,
+                                     vocab)
+            step, _ = tfm.make_train_step(
+                mesh, n_layers, d, heads, ff, vocab, lr=0.2,
+                masked=masked, loss_chunks=chunks)
+            args = (tokens, labels, mask) if masked else (tokens, labels)
+            for _ in range(3):
+                params, loss = step(params, *args)
+            outs[name] = (float(loss), jax.device_get(
+                jax.tree.leaves(params)))
+        for name in ("chunk4", "chunk3"):
+            np.testing.assert_allclose(outs[name][0], outs["dense"][0],
+                                       rtol=1e-6, atol=1e-7)
+            for a, b in zip(outs[name][1], outs["dense"][1]):
+                np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+    # eval path shares the implementation
+    prng.seed_all(11)
+    params = tfm.init_params(prng.get(), n_layers, d, heads, ff, vocab)
+    ev_d = tfm.make_eval_loss(mesh, n_layers, d, heads, ff, vocab)
+    ev_c = tfm.make_eval_loss(mesh, n_layers, d, heads, ff, vocab,
+                              loss_chunks=4)
+    np.testing.assert_allclose(float(ev_c(params, tokens, labels)),
+                               float(ev_d(params, tokens, labels)),
+                               rtol=1e-6, atol=1e-7)

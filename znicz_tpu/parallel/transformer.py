@@ -153,8 +153,52 @@ def _check_tp(mesh: Mesh, heads: int, d: int, ff: int) -> int:
     return heads // tp_size
 
 
+def _ce_token_nll_sum(x, labels, head, n_chunks, weights):
+    """Σ weights·(-log p[label]) over the local tokens, computed
+    ``n_chunks`` tokens-chunks at a time with the chunk rematerialized:
+    the full ``(tokens, vocab)`` f32 logits tensor — ~2 GB at the bench
+    shape, and the dominant HBM stream of a small-d model — never
+    exists; only one chunk of logits is live (forward AND backward,
+    ``jax.checkpoint`` recomputes it in the transpose).  Per-token
+    numerics are identical to the dense path (row-wise log_softmax);
+    only the cross-token summation order differs."""
+    b, t, d = x.shape
+    n_tok = b * t
+    xf = x.reshape(n_tok, d)
+    lf = labels.reshape(n_tok)
+    wf = jnp.broadcast_to(weights, (b, t)).reshape(n_tok) \
+        if weights is not None else None
+    chunk = -(-n_tok // n_chunks)
+    pad = chunk * n_chunks - n_tok
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        lf = jnp.pad(lf, (0, pad))
+        # padded rows weigh 0 so they contribute nothing either way
+        wf = jnp.pad(jnp.ones((n_tok,), jnp.float32) if wf is None
+                     else wf, (0, pad))
+    elif wf is None:
+        wf = jnp.ones((n_tok,), jnp.float32)
+
+    @jax.checkpoint
+    def chunk_nll(xc, lc, wc):
+        logits = (xc @ head).astype(jnp.float32)     # (chunk, vocab)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, lc[:, None], axis=-1)[:, 0]
+        return (-picked * wc).sum()
+
+    # lax.map (carry-free scan): a scan carry would need its varying-axes
+    # type pinned to whatever mesh axes the enclosing shard_map uses,
+    # which this helper cannot know
+    totals = lax.map(
+        lambda inp: chunk_nll(*inp),
+        (xf.reshape(n_chunks, chunk, d), lf.reshape(n_chunks, chunk),
+         wf.reshape(n_chunks, chunk)))
+    return totals.sum()
+
+
 def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
-                interp, cdt, remat: bool = False):
+                interp, cdt, remat: bool = False,
+                loss_chunks: int | None = None):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -168,23 +212,33 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
             _block, static_argnums=(2, 3, 4, 5))  # type: ignore[assignment]
     for p in ps["blocks"]:
         x = blk(x, p, heads_local, causal, use_flash, interp)
-    logits = (x @ ps["head"]).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    b_l, t_l = labels.shape
+    mvec = mask[:, None].astype(jnp.float32) if mask is not None else None
+    # either path yields the LOCAL weighted nll sum; normalization below
+    # is shared so dense and chunked conventions can never drift
+    if loss_chunks and loss_chunks > 1:
+        nll = _ce_token_nll_sum(x, labels, ps["head"], loss_chunks, mvec)
+    else:
+        logits = (x @ ps["head"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, labels[..., None],
+                                     axis=-1)[..., 0]
+        nll = -picked.sum() if mvec is None else \
+            -(picked * jnp.broadcast_to(mvec, picked.shape)).sum()
     if mask is None:
-        # psum makes AD emit globally-reduced grads for replicated
-        # params; model-sharded params get their local shard's grad
-        return lax.psum(-picked.mean(), ("data", "seq"))
+        # psum-of-local-means; it makes AD emit globally-reduced grads
+        # for replicated params; model-sharded params get their local
+        # shard's grad
+        return lax.psum(nll / (b_l * t_l), ("data", "seq"))
     # masked variant, SAME n_shards-scaled convention as the unmasked
     # psum-of-local-means (the caller divides loss and grads by n_shards)
-    m = jnp.broadcast_to(mask[:, None].astype(jnp.float32), picked.shape)
     n_seq = lax.psum(1, "seq")
     n_shards = lax.psum(1, "data") * n_seq
     # the mask is seq-INVARIANT (each seq shard sees the same rows), so
     # its token count reduces over "data" and multiplies by n_seq — a
     # joint psum would mix varying and invarying axis states
-    total = lax.psum(m.sum(), "data") * n_seq
-    return n_shards * lax.psum(-(picked * m).sum(), ("data", "seq")) / \
+    total = lax.psum(mask.astype(jnp.float32).sum() * t_l, "data") * n_seq
+    return n_shards * lax.psum(nll, ("data", "seq")) / \
         jnp.maximum(total, 1.0)
 
 
@@ -204,7 +258,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
                     compute_dtype=None, shard_update: bool = False,
                     masked: bool = False, donate: bool = False,
-                    remat: bool = False):
+                    remat: bool = False, loss_chunks: int | None = None):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -215,6 +269,11 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     wraps each block in ``jax.checkpoint``: backward recomputes block
     activations instead of saving them — the standard long-context
     trade (HBM for FLOPs) once t grows past what activations fit.
+    ``loss_chunks=k`` computes the CE loss k token-chunks at a time
+    (:func:`_ce_token_nll_sum`) so the ``(tokens, vocab)`` f32 logits
+    never materialize — the dominant HBM stream when vocab ≫ d.  Loss
+    differs from the dense path only in summation order (~1 ulp); the
+    dense default keeps historical pins bit-stable.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -257,7 +316,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
         def loss_fn(ps):
             return _forward_ce(ps, tokens, labels, mask, heads_local,
                                causal, use_flash, interp, cdt,
-                               remat=remat)
+                               remat=remat, loss_chunks=loss_chunks)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
@@ -295,7 +354,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
 
 def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                    vocab: int, causal: bool = True, compute_dtype=None,
-                   masked: bool = False):
+                   masked: bool = False, loss_chunks: int | None = None):
     """-> jitted ``eval_loss(params, tokens, labels[, mask]) -> loss`` —
     the train step's forward + CE loss (the SHARED ``_forward_ce`` body,
     so the numerics cannot drift) with no update: validation/test
@@ -310,7 +369,8 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     def local_eval(params, tokens, labels, mask=None):
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
         return _forward_ce(params, tokens, labels, mask, heads_local,
-                           causal, use_flash, interp, cdt) / n_shards
+                           causal, use_flash, interp, cdt,
+                           loss_chunks=loss_chunks) / n_shards
 
     batch_spec = P("data", "seq")
     in_specs = (specs, batch_spec, batch_spec) + \

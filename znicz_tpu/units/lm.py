@@ -36,7 +36,8 @@ class TransformerLMStep(AcceleratedUnit):
 
     def __init__(self, workflow=None, loader=None, n_layers: int = 2,
                  d: int = 32, heads: int = 2, ff: Optional[int] = None,
-                 lr: float = 0.1, mesh=None, **kwargs) -> None:
+                 lr: float = 0.1, mesh=None,
+                 loss_chunks: Optional[int] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.loader = loader
         self.n_layers = int(n_layers)
@@ -45,6 +46,9 @@ class TransformerLMStep(AcceleratedUnit):
         self.ff = int(ff) if ff is not None else 4 * self.d
         self.lr = float(lr)
         self.mesh = mesh
+        #: CE loss chunk count — set when vocab ≫ d so the (tokens,
+        #: vocab) logits never materialize (docs/TUNING.md)
+        self.loss_chunks = loss_chunks
         self.vocab_size: Optional[int] = None
         # decision links (DecisionMSE contract)
         self.minibatch_mse = 0.0
@@ -80,10 +84,11 @@ class TransformerLMStep(AcceleratedUnit):
         # policy) contribute neither loss nor gradients
         self._step, _ = tfm.make_train_step(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
-            self.vocab_size, lr=self.lr, masked=True)
+            self.vocab_size, lr=self.lr, masked=True,
+            loss_chunks=self.loss_chunks)
         self._eval = tfm.make_eval_loss(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
-            self.vocab_size, masked=True)
+            self.vocab_size, masked=True, loss_chunks=self.loss_chunks)
         #: minibatch placement: batch over data, time over seq
         self._batch_sharding = NamedSharding(self.mesh, P("data", "seq"))
         self._mask_sharding = NamedSharding(self.mesh, P("data"))
