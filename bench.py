@@ -230,8 +230,28 @@ def bench_alexnet(batch=128, K=16, reps=3):
     x = rng.normal(size=(batch, 227, 227, 3)).astype(np.float32)
     labels = rng.integers(0, 1000, batch).astype(np.int32)
     sps = _throughput(w.step, x, labels, K, reps)
-    return _emit("alexnet_b128_train_samples_per_sec_per_chip", sps,
-                 w.forwards, batch, state_dtype="bfloat16")
+    flagship = _emit("alexnet_b128_train_samples_per_sec_per_chip", sps,
+                     w.forwards, batch, state_dtype="bfloat16")
+    if os.environ.get("BENCH_ALEXNET_B256"):
+        # ceiling probe (watcher-budget only — the driver's default
+        # child budget must not pay this extra compile): 2x batch shows
+        # what the conv stack sustains when fixed costs amortize, the
+        # same A/B CIFAR runs at b2048.  AFTER the flagship emit so a
+        # hang here can never lose the trend-tracked b128 line, and
+        # named so main()'s "alexnet" flagship filter cannot pick it
+        del w
+        prng.seed_all(7)
+        w2 = build(max_epochs=1, minibatch_size=2 * batch, n_classes=1000,
+                   input_size=227, n_train=8, n_valid=0,
+                   loader_config={"n_classes": 8},
+                   optimizer_config={"state_dtype": "bfloat16"})
+        w2.initialize(device=TPUDevice())
+        x2 = rng.normal(size=(2 * batch, 227, 227, 3)).astype(np.float32)
+        l2 = rng.integers(0, 1000, 2 * batch).astype(np.int32)
+        _emit("ceiling_alexnet_b256_train_samples_per_sec_per_chip",
+              _throughput(w2.step, x2, l2, max(K // 2, 4), reps),
+              w2.forwards, 2 * batch, state_dtype="bfloat16")
+    return flagship
 
 
 def bench_cifar(batch=512, K=64, reps=3):
@@ -366,6 +386,12 @@ def bench_transformer(batch=8, seq=2048, d=512, n_layers=6, heads=8,
     extra = {}
     if peak and jax.default_backend() != "cpu":
         extra["mfu"] = round(6.0 * n_params * tps / peak, 4)
+        # the embedding LOOKUP does no matmul FLOPs (gather fwd /
+        # scatter-add bwd), so 6N with emb included over-credits ~1.4x
+        # at this vocab/d; report the matmul-only figure alongside for
+        # honest accounting (the r3 gate tracks "mfu")
+        extra["mfu_matmul_only"] = round(
+            6.0 * (n_params - vocab * d) * tps / peak, 4)
     if attention == "xla" and jax.default_backend() != "cpu":
         # the headline kernel must never silently die on hardware
         # (VERDICT r3 weak #5) — make the degradation loud, and say

@@ -1,0 +1,168 @@
+"""Ring×flash composition tests: the Pallas flash kernel as ring
+attention's per-block math (parallel/ring_attention.py::
+ring_flash_attention), merged across ring steps by lse weight.  All
+interpret-mode on the CPU mesh; the compiled path shares every kernel
+with the plain flash family the hardware sweep covers."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from znicz_tpu.core import prng
+from znicz_tpu.parallel.mesh import make_mesh
+from znicz_tpu.parallel import transformer as tfm
+from znicz_tpu.parallel.ring_attention import (ring_attention,
+                                               ring_flash_attention)
+
+
+def _dense_o_lse(q, k, v, causal):
+    """Folded-layout dense oracle returning (o, lse) exactly as the
+    kernel defines them (same -1e30 mask constant)."""
+    dh = q.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(dh)
+    if causal:
+        t = s.shape[-1]
+        qpos = jnp.arange(t)[:, None]
+        kpos = jnp.arange(t)[None, :]
+        s = jnp.where(kpos > qpos, jnp.float32(-1e30), s)
+    lse = jax.nn.logsumexp(s, axis=-1, keepdims=True)
+    o = jnp.einsum("bqk,bkd->bqd", jnp.exp(s - lse), v)
+    return o, lse
+
+
+def test_flash_lse_grads_match_dense_oracle():
+    """flash_attention_lse: BOTH outputs differentiable — the lse
+    cotangent folds into the shared backward kernel as Δ−dlse.  Loss
+    touches o and lse with independent random weights so dlse ≠ 0."""
+    from znicz_tpu.ops.pallas.attention import flash_attention_lse
+
+    bh, t, dh = 2, 256, 64
+    rng = np.random.default_rng(3)
+    q, k, v, wo, wl = (jnp.asarray(
+        rng.normal(size=sh).astype(np.float32)) for sh in
+        [(bh, t, dh)] * 4 + [(bh, t, 1)])
+
+    for causal in (False, True):
+        def loss_flash(q, k, v):
+            o, lse = flash_attention_lse(q, k, v, causal, True)
+            return (o * wo).sum() + (lse * wl).sum()
+
+        def loss_dense(q, k, v):
+            o, lse = _dense_o_lse(q, k, v, causal)
+            return (o * wo).sum() + (lse * wl).sum()
+
+        lf, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        ld, gd = jax.value_and_grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def _shard_ring(fn_inner, mesh, **kw):
+    from znicz_tpu.parallel.transformer import shard_map
+
+    spec = P(None, "seq", None, None)
+    return shard_map(fn_inner, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, **kw)
+
+
+def test_ring_flash_matches_dense_and_ring(cpu_devices):
+    """ring_flash_attention over a 2-way sharded seq axis == dense
+    attention on the full sequence == the dense-local ring path, values
+    AND grads, causal and non-causal.  check_vma=False is the
+    interpret-mode Pallas limitation (transformer.py's long note); the
+    grad parity against the no-pallas ring path is exactly the check
+    that the relaxed psum transposition did not corrupt AD here."""
+    mesh = make_mesh({"data": 1, "seq": 2, "model": 1})
+    b, t, h, dh = 1, 512, 2, 64
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, dh))
+                           .astype(np.float32)) for _ in range(3))
+    kw = tfm._shardmap_kwargs(True, True)
+
+    for causal in (False, True):
+        ringf = _shard_ring(
+            lambda q, k, v: ring_flash_attention(
+                q, k, v, "seq", causal=causal, interpret=True), mesh, **kw)
+        ringd = _shard_ring(
+            lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
+            mesh)
+
+        def dense(q, k, v):
+            fold = q.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
+            o, _ = _dense_o_lse(fold,
+                                k.transpose(0, 2, 1, 3).reshape(
+                                    b * h, t, dh),
+                                v.transpose(0, 2, 1, 3).reshape(
+                                    b * h, t, dh), causal)
+            return o.reshape(b, h, t, dh).transpose(0, 2, 1, 3)
+
+        o_rf = ringf(q, k, v)
+        np.testing.assert_allclose(np.asarray(o_rf),
+                                   np.asarray(dense(q, k, v)),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(o_rf),
+                                   np.asarray(ringd(q, k, v)),
+                                   rtol=2e-4, atol=2e-4)
+
+        # grads: scalar loss touching every output element
+        wsum = jnp.asarray(rng.normal(size=(b, t, h, dh))
+                           .astype(np.float32))
+        g_rf = jax.grad(lambda *a: (ringf(*a) * wsum).sum(),
+                        argnums=(0, 1, 2))(q, k, v)
+        g_de = jax.grad(lambda *a: (dense(*a) * wsum).sum(),
+                        argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_rf, g_de):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=3e-4, atol=3e-4)
+
+
+def test_transformer_ring_flash_forward_matches_ring(cpu_devices):
+    """The full-transformer composition on a seq=2 mesh: ring_flash's
+    eval loss (forward through every block + psum'd CE) matches the
+    dense-local ring path at several param draws.
+
+    FORWARD-ONLY on purpose.  Interpret-mode Pallas needs
+    ``check_vma=False`` on a multi-device mesh (the HLO interpreter's
+    internal dynamic_slices trip the checker — verified directly), and
+    the relaxed checker corrupts REPLICATED-param gradient reduction at
+    seq>1 (measured: losses diverge from step 2).  The composition's AD
+    itself is pinned by test_ring_flash_matches_dense_and_ring (grads
+    through shard_map w.r.t. all inputs); replicated-grad integration
+    runs compiled on real hardware where the checker stays ON."""
+    from znicz_tpu.core.config import root
+    from znicz_tpu.ops.pallas.attention import supported
+
+    n_layers, d, heads, ff, vocab = 1, 128, 2, 64, 11
+    assert supported(128, d // heads)     # t_loc=128 per seq shard
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, vocab, (2, 256)).astype(np.int32)
+    labels = ((tokens + 1) % vocab).astype(np.int32)
+    mesh = make_mesh({"data": 1, "seq": 2, "model": 1})
+
+    losses = {}
+    for name, flags in (
+            ("ring", {"flash_attention": False}),
+            ("ring_flash", {"flash_attention": True,
+                            "pallas_interpret": True,
+                            "ring_flash_interpret": True})):
+        for key, val in flags.items():
+            setattr(root.common.engine, key, val)
+        try:
+            ev = tfm.make_eval_loss(mesh, n_layers, d, heads, ff, vocab)
+            run = []
+            for seed in (13, 29, 57):
+                prng.seed_all(seed)
+                params = tfm.init_params(prng.get(), n_layers, d, heads,
+                                         ff, vocab)
+                run.append(float(ev(params, tokens, labels)))
+            losses[name] = run
+        finally:
+            root.common.engine.flash_attention = True
+            root.common.engine.pallas_interpret = False
+            root.common.engine.ring_flash_interpret = False
+    np.testing.assert_allclose(losses["ring_flash"], losses["ring"],
+                               rtol=1e-4, atol=1e-5)

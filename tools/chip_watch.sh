@@ -92,6 +92,7 @@ bench.bench_pallas_parity()
   # run reuses the cache this run warms
   run_stage bench_full 2400 .scratch/bench_full_r5.log \
     env BENCH_TPU_TIMEOUT=1500 BENCH_TPU_RETRY_TIMEOUT=600 \
+        BENCH_ALEXNET_B256=1 \
     python bench.py || return 1
   grep -q '"metric"' .scratch/bench_full_r5.log || {
     log "bench landed no result lines"; return 1; }

@@ -156,14 +156,21 @@ def _flash_fwd(q, k, v, causal, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, interpret, res, do):
+def _flash_bwd(causal, interpret, res, do, dlse=None):
     q, k, v, o, lse = res
     bh, t, dh = q.shape
     block_q = _pick_block_q(t)
     # Δ = rowsum(do ⊙ o) — the lse-side term of the softmax jacobian;
-    # shaped (bh, t, 1) like lse for the same Mosaic-tiling reason
+    # shaped (bh, t, 1) like lse for the same Mosaic-tiling reason.
+    # When the caller also differentiates through lse (the ring×flash
+    # merge), its cotangent folds into the SAME kernel:
+    #   ds = p·(dp − Δ)·scale  and  ∂lse/∂s = p·scale
+    #   ⇒ ds_total = p·(dp − (Δ − dlse))·scale
+    # so Δ' = Δ − dlse and the backward kernel is reused unchanged.
     delta = (do.astype(jnp.float32) *
              o.astype(jnp.float32)).sum(-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse
     kern = partial(_bwd_kernel, causal=causal,
                    sm_scale=1.0 / float(np.sqrt(dh)), block_q=block_q)
     full = lambda shape: pl.BlockSpec(                 # noqa: E731
@@ -194,6 +201,31 @@ def _flash_bwd(causal, interpret, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_lse(q, k, v, causal: bool = False,
+                        interpret: bool = False):
+    """Flash attention over FOLDED per-head tensors ``(b·h, t, dh)``
+    returning ``(o, lse)`` with BOTH outputs differentiable — the
+    building block for blockwise composition (ring attention merges
+    per-block results by lse weight, so lse carries real cotangents).
+    Same kernels as :func:`flash_attention`; the lse cotangent folds
+    into the backward's Δ term (see :func:`_flash_bwd`)."""
+    return _call_fwd(q, k, v, causal, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, interpret):
+    o, lse = _call_fwd(q, k, v, causal, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, interpret, res, cts):
+    do, dlse = cts
+    return _flash_bwd(causal, interpret, res, do, dlse)
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def supported(t: int, dh: int) -> bool:

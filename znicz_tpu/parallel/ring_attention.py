@@ -74,6 +74,85 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     return jnp.transpose(out, (0, 2, 1, 3))  # (b, t_loc, h, dh)
 
 
+def _merge_blocks(o, lse, o_s, lse_s, include):
+    """Numerically-stable lse-weighted merge of two NORMALIZED attention
+    results over the same queries but disjoint key blocks:
+    ``softmax``-combining ``(o, lse)`` with ``(o_s, lse_s)``;
+    ``include=False`` leaves the accumulator unchanged (a causally
+    excluded future block).  All f32; shapes ``o`` (bh, t, dh), ``lse``
+    (bh, t, 1)."""
+    m = jnp.maximum(lse, lse_s)
+    w_old = jnp.exp(lse - m)
+    w_new = jnp.exp(lse_s - m)
+    tot = w_old + w_new
+    o_out = (o * w_old + o_s.astype(jnp.float32) * w_new) / tot
+    lse_out = m + jnp.log(tot)
+    # excluded blocks leave the accumulator BIT-EXACT (a select, not a
+    # zero-weight pass through the merge arithmetic)
+    return (jnp.where(include, o_out, o),
+            jnp.where(include, lse_out, lse))
+
+
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
+                         interpret: bool = False):
+    """Ring attention whose LOCAL block math is the Pallas flash kernel
+    (ops/pallas/attention.py) — the long-context composition: K/V blocks
+    rotate over ICI exactly as in :func:`ring_attention`, but each ring
+    step computes its (q-block × k-block) attention without ever
+    materializing the score matrix, and per-block results combine by the
+    lse merge rule (:func:`_merge_blocks`).
+
+    Block-aligned causality needs NO kernel offsets: the diagonal step
+    (own k block) runs the kernel's causal mask as-is (q/k positions
+    aligned), fully-past blocks run unmasked, fully-future blocks are
+    excluded from the merge.  Gradients flow through the merge into both
+    o and lse — :func:`flash_attention_lse` carries the lse cotangent
+    into the shared backward kernel.
+
+    Same signature/semantics as :func:`ring_attention` (``(b, t_loc, h,
+    dh)`` sequence-sharded, called inside shard_map)."""
+    from znicz_tpu.ops.pallas.attention import flash_attention_lse
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_loc, h, dh = q.shape
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t_loc, dh)
+
+    qf = fold(q)
+
+    def step(carry, _):
+        o, lse, k_blk, v_blk, blk_idx = carry
+        kf, vf = fold(k_blk), fold(v_blk)
+        if causal:
+            # the first ring step holds the own (diagonal) block, so the
+            # cond's causal branch runs at least once per device
+            o_s, lse_s = lax.cond(
+                blk_idx == my_idx,
+                lambda: flash_attention_lse(qf, kf, vf, True, interpret),
+                lambda: flash_attention_lse(qf, kf, vf, False, interpret))
+            include = blk_idx <= my_idx
+        else:
+            o_s, lse_s = flash_attention_lse(qf, kf, vf, False, interpret)
+            include = True
+        o, lse = _merge_blocks(o, lse, o_s, lse_s, include)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        blk_idx = (blk_idx - 1) % axis_size
+        return (o, lse, k_blk, v_blk, blk_idx), None
+
+    # accumulator init mirrors ring_attention: derive from q so the
+    # varying-axis type matches the loop-updated values
+    o0 = fold(q).astype(jnp.float32) * 0.0             # (bh, t_loc, dh)
+    lse0 = o0[..., :1] - jnp.inf                       # (bh, t_loc, 1)
+    (o, _, _, _, _), _ = lax.scan(
+        step, (o0, lse0, k, v, my_idx), None, length=axis_size)
+    out = o.reshape(b, h, t_loc, dh).astype(q.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))            # (b, t_loc, h, dh)
+
+
 def ring_mha_forward(x, params: dict, n_heads: int, axis_name: str,
                      causal: bool = False):
     """MHA with ring attention: x ``(b, t_local, d)`` sequence-sharded;

@@ -67,6 +67,23 @@ def _flash_eligible(mesh: Mesh, interpret: bool) -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+def _ring_flash_eligible(mesh: Mesh, interpret: bool) -> bool:
+    """Flash-in-ring (ring_flash_attention) for a SHARDED seq axis: the
+    kernel runs per ring step on (t_loc × t_loc) blocks and results
+    merge by lse weight.  Same ``flash_attention`` flag; compiled TPU
+    backends only — interpret mode must be opted into explicitly
+    (``engine.ring_flash_interpret``, used by the parity tests, which
+    also need the relaxed vma checker of :func:`_shardmap_kwargs`)."""
+    from znicz_tpu.core.config import root
+    if not bool(root.common.engine.get("flash_attention", True)):
+        return False
+    if mesh.shape.get("seq", 1) == 1:
+        return False
+    if interpret:
+        return bool(root.common.engine.get("ring_flash_interpret", False))
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def _default_compute_dtype(compute_dtype=None):
     """Explicit dtype wins; None defers to the framework-wide precision
     policy (core.backends.resolve_compute_dtype) for this process's
@@ -115,7 +132,7 @@ def param_specs(n_layers: int):
 
 
 def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
-           interpret: bool = False):
+           interpret: bool = False, use_ring_flash: bool = False):
     """One transformer block on local shards: ring attention (seq axis)
     with tp-sharded heads, then Megatron MLP (model axis).  With the seq
     axis unsharded, ``use_flash`` swaps the attention core for the Pallas
@@ -135,6 +152,10 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
     if use_flash and pattn.supported(t_loc, q.shape[-1]):
         o = pattn.flash_attention(q, k, v, causal=causal,
                                   interpret=interpret)
+    elif use_ring_flash and pattn.supported(t_loc, q.shape[-1]):
+        from znicz_tpu.parallel.ring_attention import ring_flash_attention
+        o = ring_flash_attention(q, k, v, "seq", causal=causal,
+                                 interpret=interpret)
     else:
         o = ring_attention(q, k, v, "seq", causal=causal)
     o = o.reshape(b, t_loc, -1)                      # (b, t_loc, d_local)
@@ -198,7 +219,8 @@ def _ce_token_nll_sum(x, labels, head, n_chunks, weights):
 
 def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 interp, cdt, remat: bool = False,
-                loss_chunks: int | None = None):
+                loss_chunks: int | None = None,
+                use_ring_flash: bool = False):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -209,9 +231,11 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     blk = _block
     if remat:
         blk = jax.checkpoint(
-            _block, static_argnums=(2, 3, 4, 5))  # type: ignore[assignment]
+            _block,
+            static_argnums=(2, 3, 4, 5, 6))  # type: ignore[assignment]
     for p in ps["blocks"]:
-        x = blk(x, p, heads_local, causal, use_flash, interp)
+        x = blk(x, p, heads_local, causal, use_flash, interp,
+                use_ring_flash)
     b_l, t_l = labels.shape
     mvec = mask[:, None].astype(jnp.float32) if mask is not None else None
     # either path yields the LOCAL weighted nll sum; normalization below
@@ -300,6 +324,18 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
     use_flash = _flash_eligible(mesh, interp)
+    use_ring_flash = _ring_flash_eligible(mesh, interp)
+    if use_ring_flash and interp:
+        # eval-only mode: interpret-Pallas needs check_vma=False at
+        # seq>1, which corrupts replicated-param gradient reduction
+        # (docs/TUNING.md "Ring×flash" §3) — refuse to build a silently
+        # wrong TRAINING step
+        raise ValueError(
+            "engine.ring_flash_interpret is eval-only (forward parity "
+            "tests): a train step under the relaxed vma checker gets "
+            "corrupted replicated-param gradients at seq>1. Train with "
+            "engine.flash_attention=False (dense ring) in interpret "
+            "mode, or run compiled on TPU.")
     n_data = mesh.shape["data"]
 
     def _sharded_sgd(w, g, scale):
@@ -316,7 +352,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
         def loss_fn(ps):
             return _forward_ce(ps, tokens, labels, mask, heads_local,
                                causal, use_flash, interp, cdt,
-                               remat=remat, loss_chunks=loss_chunks)
+                               remat=remat, loss_chunks=loss_chunks,
+                               use_ring_flash=use_ring_flash)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
@@ -348,7 +385,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
         ((P("data"),) if masked else ())
     step = shard_map(
         local_step, mesh=mesh, in_specs=in_specs,
-        out_specs=(specs, P()), **_shardmap_kwargs(use_flash, interp))
+        out_specs=(specs, P()),
+        **_shardmap_kwargs(use_flash or use_ring_flash, interp))
     return jax.jit(step, donate_argnums=(0,) if donate else ()), specs
 
 
@@ -365,18 +403,21 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
     use_flash = _flash_eligible(mesh, interp)
+    use_ring_flash = _ring_flash_eligible(mesh, interp)
 
     def local_eval(params, tokens, labels, mask=None):
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
         return _forward_ce(params, tokens, labels, mask, heads_local,
                            causal, use_flash, interp, cdt,
-                           loss_chunks=loss_chunks) / n_shards
+                           loss_chunks=loss_chunks,
+                           use_ring_flash=use_ring_flash) / n_shards
 
     batch_spec = P("data", "seq")
     in_specs = (specs, batch_spec, batch_spec) + \
         ((P("data"),) if masked else ())
     fn = shard_map(local_eval, mesh=mesh, in_specs=in_specs,
-                   out_specs=P(), **_shardmap_kwargs(use_flash, interp))
+                   out_specs=P(),
+                   **_shardmap_kwargs(use_flash or use_ring_flash, interp))
     return jax.jit(fn)
 
 
