@@ -116,10 +116,12 @@ def init_params(gen, n_layers: int, d: int, heads: int, ff: int,
             "blocks": blocks}
 
 
-def param_specs(n_layers: int):
+def param_specs(n_layers: int, head_sharded: bool = False):
     """PartitionSpecs matching init_params: attention qkv column-sharded,
     wo row-sharded, MLP Megatron-sharded over ``model``; the rest
-    replicated."""
+    replicated.  ``head_sharded`` vocab-shards the LM head over
+    ``model`` (Megatron parallel cross-entropy — pair with
+    ``make_train_step(head_sharded=True)``)."""
     blk = {
         "ln1_g": P(), "ln1_b": P(),
         "wq": P(None, "model"), "wk": P(None, "model"),
@@ -128,7 +130,8 @@ def param_specs(n_layers: int):
         "w1": P(None, "model"), "b1": P("model"),
         "w2": P("model", None), "b2": P(),
     }
-    return {"emb": P(), "head": P(), "blocks": [dict(blk)] * n_layers}
+    head = P(None, "model") if head_sharded else P()
+    return {"emb": P(), "head": head, "blocks": [dict(blk)] * n_layers}
 
 
 def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
@@ -166,15 +169,59 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
     return x
 
 
-def _check_tp(mesh: Mesh, heads: int, d: int, ff: int) -> int:
+def _check_tp(mesh: Mesh, heads: int, d: int, ff: int,
+              vocab_sharded: int | None = None) -> int:
     tp_size = mesh.shape["model"]
     if heads % tp_size or d % tp_size or ff % tp_size:
         raise ValueError(f"tp={tp_size} must divide heads={heads}, "
                          f"d={d} and ff={ff}")
+    if vocab_sharded is not None and vocab_sharded % tp_size:
+        raise ValueError(f"head_sharded needs vocab={vocab_sharded} "
+                         f"divisible by tp={tp_size}")
     return heads // tp_size
 
 
-def _ce_token_nll_sum(x, labels, head, n_chunks, weights):
+def _dense_chunk_nll(head):
+    """-> chunk fn: Σ w·(-log p[label]) from replicated-head logits."""
+    @jax.checkpoint
+    def chunk_nll(xc, lc, wc):
+        logits = (xc @ head).astype(jnp.float32)     # (chunk, vocab)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, lc[:, None], axis=-1)[:, 0]
+        return (-picked * wc).sum()
+    return chunk_nll
+
+
+def _vshard_chunk_nll(head_local, axis_name: str = "model"):
+    """-> chunk fn for a VOCAB-SHARDED head (Megatron parallel cross
+    entropy, arXiv:1909.08053 §3): each model shard computes its
+    ``(chunk, vocab/n)`` logit columns; the stable-softmax max and the
+    sum-exp reduce with one pmax + one psum, and the label's logit
+    comes from its owning shard via a masked psum — the full-vocab
+    logits row never exists on any device."""
+    @jax.checkpoint
+    def chunk_nll(xc, lc, wc):
+        logits = (xc @ head_local).astype(jnp.float32)  # (chunk, v_loc)
+        v_loc = logits.shape[-1]
+        start = lax.axis_index(axis_name) * v_loc
+        # the max shift is gradient-neutral (the lse gradient is the
+        # softmax either way).  stop_gradient goes on pmax's INPUT: the
+        # zero tangent keeps AD from needing pmax's (missing) JVP rule,
+        # and pmax — unlike all_gather — types as model-INVARIANT under
+        # the shard_map vma checker, which the P() loss out_spec needs
+        m = lax.pmax(lax.stop_gradient(logits.max(-1)), axis_name)
+        se = lax.psum(jnp.exp(logits - m[:, None]).sum(-1), axis_name)
+        lse = m + jnp.log(se)
+        lc_loc = jnp.clip(lc - start, 0, v_loc - 1)
+        mine = (lc >= start) & (lc < start + v_loc)
+        picked_loc = jnp.take_along_axis(logits, lc_loc[:, None],
+                                         axis=-1)[:, 0]
+        picked = lax.psum(jnp.where(mine, picked_loc, 0.0), axis_name)
+        return (-(picked - lse) * wc).sum()
+    return chunk_nll
+
+
+def _ce_token_nll_sum(x, labels, chunk_nll, n_chunks, weights):
     """Σ weights·(-log p[label]) over the local tokens, computed
     ``n_chunks`` tokens-chunks at a time with the chunk rematerialized:
     the full ``(tokens, vocab)`` f32 logits tensor — ~2 GB at the bench
@@ -200,13 +247,6 @@ def _ce_token_nll_sum(x, labels, head, n_chunks, weights):
     elif wf is None:
         wf = jnp.ones((n_tok,), jnp.float32)
 
-    @jax.checkpoint
-    def chunk_nll(xc, lc, wc):
-        logits = (xc @ head).astype(jnp.float32)     # (chunk, vocab)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        picked = jnp.take_along_axis(logp, lc[:, None], axis=-1)[:, 0]
-        return (-picked * wc).sum()
-
     # lax.map (carry-free scan): a scan carry would need its varying-axes
     # type pinned to whatever mesh axes the enclosing shard_map uses,
     # which this helper cannot know
@@ -220,7 +260,8 @@ def _ce_token_nll_sum(x, labels, head, n_chunks, weights):
 def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 interp, cdt, remat: bool = False,
                 loss_chunks: int | None = None,
-                use_ring_flash: bool = False):
+                use_ring_flash: bool = False,
+                head_sharded: bool = False):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -239,9 +280,14 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     b_l, t_l = labels.shape
     mvec = mask[:, None].astype(jnp.float32) if mask is not None else None
     # either path yields the LOCAL weighted nll sum; normalization below
-    # is shared so dense and chunked conventions can never drift
-    if loss_chunks and loss_chunks > 1:
-        nll = _ce_token_nll_sum(x, labels, ps["head"], loss_chunks, mvec)
+    # is shared so dense and chunked conventions can never drift.  A
+    # vocab-sharded head always routes through the chunk helper (its CE
+    # needs the collective-reduced softmax; n_chunks=1 when unchunked).
+    if head_sharded or (loss_chunks and loss_chunks > 1):
+        fn = _vshard_chunk_nll(ps["head"]) if head_sharded else \
+            _dense_chunk_nll(ps["head"])
+        n_chunks = loss_chunks if (loss_chunks and loss_chunks > 1) else 1
+        nll = _ce_token_nll_sum(x, labels, fn, n_chunks, mvec)
     else:
         logits = (x @ ps["head"]).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -282,7 +328,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
                     compute_dtype=None, shard_update: bool = False,
                     masked: bool = False, donate: bool = False,
-                    remat: bool = False, loss_chunks: int | None = None):
+                    remat: bool = False, loss_chunks: int | None = None,
+                    head_sharded: bool = False):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -298,6 +345,11 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     never materialize — the dominant HBM stream when vocab ≫ d.  Loss
     differs from the dense path only in summation order (~1 ulp); the
     dense default keeps historical pins bit-stable.
+    ``head_sharded=True`` vocab-shards the LM head over ``model`` and
+    computes the CE with Megatron parallel cross-entropy
+    (:func:`_vshard_chunk_nll`): head memory, the head GEMM, and its
+    gradient all divide by tp, at the cost of one pmax + two psums per
+    chunk; composes with ``loss_chunks``.  Requires ``vocab % tp == 0``.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -318,8 +370,9 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     ZeRO-1 memory win is real) must match.  Tensor-sharded leaves
     already live partitioned and update locally.
     """
-    heads_local = _check_tp(mesh, heads, d, ff)
-    specs = param_specs(n_layers)
+    heads_local = _check_tp(mesh, heads, d, ff,
+                            vocab if head_sharded else None)
+    specs = param_specs(n_layers, head_sharded)
     cdt = _default_compute_dtype(compute_dtype)
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
@@ -353,7 +406,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
             return _forward_ce(ps, tokens, labels, mask, heads_local,
                                causal, use_flash, interp, cdt,
                                remat=remat, loss_chunks=loss_chunks,
-                               use_ring_flash=use_ring_flash)
+                               use_ring_flash=use_ring_flash,
+                               head_sharded=head_sharded)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
@@ -392,13 +446,15 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
 
 def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                    vocab: int, causal: bool = True, compute_dtype=None,
-                   masked: bool = False, loss_chunks: int | None = None):
+                   masked: bool = False, loss_chunks: int | None = None,
+                   head_sharded: bool = False):
     """-> jitted ``eval_loss(params, tokens, labels[, mask]) -> loss`` —
     the train step's forward + CE loss (the SHARED ``_forward_ce`` body,
     so the numerics cannot drift) with no update: validation/test
     passes."""
-    heads_local = _check_tp(mesh, heads, d, ff)
-    specs = param_specs(n_layers)
+    heads_local = _check_tp(mesh, heads, d, ff,
+                            vocab if head_sharded else None)
+    specs = param_specs(n_layers, head_sharded)
     cdt = _default_compute_dtype(compute_dtype)
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
@@ -410,7 +466,8 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
         return _forward_ce(params, tokens, labels, mask, heads_local,
                            causal, use_flash, interp, cdt,
                            loss_chunks=loss_chunks,
-                           use_ring_flash=use_ring_flash) / n_shards
+                           use_ring_flash=use_ring_flash,
+                           head_sharded=head_sharded) / n_shards
 
     batch_spec = P("data", "seq")
     in_specs = (specs, batch_spec, batch_spec) + \

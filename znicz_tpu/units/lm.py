@@ -37,7 +37,8 @@ class TransformerLMStep(AcceleratedUnit):
     def __init__(self, workflow=None, loader=None, n_layers: int = 2,
                  d: int = 32, heads: int = 2, ff: Optional[int] = None,
                  lr: float = 0.1, mesh=None,
-                 loss_chunks: Optional[int] = None, **kwargs) -> None:
+                 loss_chunks: Optional[int] = None,
+                 head_sharded: bool = False, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.loader = loader
         self.n_layers = int(n_layers)
@@ -49,6 +50,9 @@ class TransformerLMStep(AcceleratedUnit):
         #: CE loss chunk count — set when vocab ≫ d so the (tokens,
         #: vocab) logits never materialize (docs/TUNING.md)
         self.loss_chunks = loss_chunks
+        #: vocab-shard the LM head over the mesh's model axis (Megatron
+        #: parallel cross-entropy; vocab must divide by tp)
+        self.head_sharded = head_sharded
         self.vocab_size: Optional[int] = None
         # decision links (DecisionMSE contract)
         self.minibatch_mse = 0.0
@@ -85,10 +89,11 @@ class TransformerLMStep(AcceleratedUnit):
         self._step, _ = tfm.make_train_step(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
             self.vocab_size, lr=self.lr, masked=True,
-            loss_chunks=self.loss_chunks)
+            loss_chunks=self.loss_chunks, head_sharded=self.head_sharded)
         self._eval = tfm.make_eval_loss(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
-            self.vocab_size, masked=True, loss_chunks=self.loss_chunks)
+            self.vocab_size, masked=True, loss_chunks=self.loss_chunks,
+            head_sharded=self.head_sharded)
         #: minibatch placement: batch over data, time over seq
         self._batch_sharding = NamedSharding(self.mesh, P("data", "seq"))
         self._mask_sharding = NamedSharding(self.mesh, P("data"))
@@ -101,7 +106,7 @@ class TransformerLMStep(AcceleratedUnit):
 
         from znicz_tpu.parallel import transformer as tfm
 
-        specs = tfm.param_specs(self.n_layers)
+        specs = tfm.param_specs(self.n_layers, self.head_sharded)
         return jax.device_put(
             params, jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), specs,
