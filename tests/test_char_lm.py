@@ -151,3 +151,18 @@ def test_char_lm_snapshotter_resume_bit_exact(tmp_path):
         np.testing.assert_allclose(a["metric_validation"],
                                    b["metric_validation"], rtol=1e-5)
     assert len(resumed) == len(full_hist)
+
+
+def test_char_lm_loss_chunks_trains(tmp_path):
+    """The chunked-CE lever is reachable from the model zoo: same
+    workflow, loss_chunks=4, CE per char still collapses (the chunk
+    count only changes summation order)."""
+    prng.seed_all(11)
+    w = char_lm.build(max_epochs=3, seq_len=32, minibatch_size=16,
+                      n_layers=2, d=32, heads=2,
+                      data_dir=str(tmp_path / "corp"), loss_chunks=4)
+    w.initialize(device=TPUDevice())
+    w.run()
+    h = w.decision.metrics_history
+    assert h[-1]["metric_validation"] < \
+        0.6 * np.log(w.loader.vocab_size)

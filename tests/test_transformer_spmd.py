@@ -212,6 +212,20 @@ def test_bf16_pipeline_step_tracks_f32(cpu_devices):
     np.testing.assert_allclose(losses["bf16"], losses["f32"], rtol=5e-2)
 
 
+def _place_like(params, mesh, specs):
+    """Sharded restore template: params' arrays device_put onto ``mesh``
+    with ``specs``'s per-leaf PartitionSpecs (the shape both orbax
+    roundtrip tests hand to load_pytree as ``like=``)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    flat_t, treedef = jax.tree.flatten(jax.tree.map(np.asarray, params))
+    flat_s = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.unflatten(treedef, [
+        jax.device_put(leaf, NamedSharding(mesh, spec))
+        for leaf, spec in zip(flat_t, flat_s)])
+
+
 def test_orbax_checkpoint_roundtrip_across_meshes(tmp_path, cpu_devices):
     """Transformer params checkpoint via orbax and restore with sharding
     taken from the target tree: the template carries MESH_B shardings,
@@ -241,12 +255,7 @@ def test_orbax_checkpoint_roundtrip_across_meshes(tmp_path, cpu_devices):
     # template placed on MESH_B with its param shardings — restore must
     # adopt them (the cross-mesh feature under test)
     mesh_b = make_mesh({"data": 4, "seq": 1, "model": 2})
-    specs = tfm.param_specs(n_layers)
-    flat_t, treedef = jax.tree.flatten(jax.tree.map(np.asarray, p))
-    flat_s = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
-    like = jax.tree.unflatten(treedef, [
-        jax.device_put(leaf, NamedSharding(mesh_b, spec))
-        for leaf, spec in zip(flat_t, flat_s)])
+    like = _place_like(p, mesh_b, tfm.param_specs(n_layers))
     restored = load_pytree(path, like=like)
     for a, b, want in zip(jax.tree.leaves(p), jax.tree.leaves(restored),
                           jax.tree.leaves(like)):
@@ -396,3 +405,42 @@ def test_head_sharded_matches_replicated(cpu_devices):
     with pytest.raises(ValueError, match="divisible"):
         tfm.make_train_step(mesh, n_layers, d, heads, ff, 17,
                             head_sharded=True)
+
+
+def test_orbax_roundtrip_head_sharded_to_replicated(tmp_path,
+                                                    cpu_devices):
+    """A checkpoint written from a VOCAB-SHARDED-head run restores into
+    a replicated-head layout (and trains on, loss-equal): the elastic
+    contract must hold across head layouts, not just mesh shapes —
+    a tp-trained model must load on a single chip."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from znicz_tpu.parallel.checkpoint import load_pytree, save_pytree
+
+    prng.seed_all(31)
+    n_layers, d, heads, ff, vocab = 1, 32, 4, 64, 16
+    p = tfm.init_params(prng.get(), n_layers, d, heads, ff, vocab)
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(0, vocab, (4, 8)).astype(np.int32)
+    labels = ((tokens + 1) % vocab).astype(np.int32)
+
+    mesh_a = make_mesh({"data": 2, "seq": 2, "model": 2})
+    step_a, _ = tfm.make_train_step(mesh_a, n_layers, d, heads, ff,
+                                    vocab, lr=0.1, head_sharded=True)
+    for _ in range(3):
+        p, _loss = step_a(p, tokens, labels)
+    path = save_pytree(str(tmp_path / "ckpt_vs"), p)
+
+    mesh_b = make_mesh({"data": 2, "seq": 1, "model": 1})
+    like = _place_like(p, mesh_b,
+                       tfm.param_specs(n_layers, head_sharded=False))
+    restored = load_pytree(path, like=like)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    step_b, _ = tfm.make_train_step(mesh_b, n_layers, d, heads, ff,
+                                    vocab, lr=0.1, head_sharded=False)
+    _p2, loss_b = step_b(restored, tokens, labels)
+    _p1, loss_ref = step_a(p, tokens, labels)
+    np.testing.assert_allclose(float(loss_b), float(loss_ref), rtol=2e-4)

@@ -20,15 +20,19 @@ from znicz_tpu.units.nn_units import NNWorkflow
 def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
           n_layers: int = 2, d: int = 32, heads: int = 2, lr: float = 0.05,
           valid_fraction: float = 0.1, mesh=None, data_dir: str = "",
-          snapshotter_config: dict | None = None) -> NNWorkflow:
+          snapshotter_config: dict | None = None,
+          loss_chunks: int | None = None,
+          head_sharded: bool = False) -> NNWorkflow:
     w = NNWorkflow(name="CharLM")
     w.repeater = Repeater(w)
     w.loader = CharSequenceLoader(
         w, data_dir=data_dir, seq_len=seq_len,
         minibatch_size=minibatch_size, valid_fraction=valid_fraction)
+    # loss_chunks / head_sharded: the vocab≫d levers (docs/TUNING.md) —
+    # chunked rematerialized CE and the Megatron vocab-sharded head
     step = w.step = TransformerLMStep(
         w, loader=w.loader, n_layers=n_layers, d=d, heads=heads, lr=lr,
-        mesh=mesh)
+        mesh=mesh, loss_chunks=loss_chunks, head_sharded=head_sharded)
     dec = w.decision = DecisionMSE(w, max_epochs=max_epochs)
     w.forwards = [step]      # snapshot inventory slot (params live here)
     w.gds = []
