@@ -444,3 +444,41 @@ def test_orbax_roundtrip_head_sharded_to_replicated(tmp_path,
     _p2, loss_b = step_b(restored, tokens, labels)
     _p1, loss_ref = step_a(p, tokens, labels)
     np.testing.assert_allclose(float(loss_b), float(loss_ref), rtol=2e-4)
+
+
+def test_moe_ffn_transformer_tp_invariant_and_learns(cpu_devices):
+    """n_experts swaps every block's dense FFN for the expert-parallel
+    top-1 MoE FFN (experts sharded over the model axis).  The step must
+    be tp-INVARIANT — identical losses with the 4 experts on one device
+    vs split across model=2 — and must still learn the shift rule."""
+    import jax
+
+    n_layers, d, heads, ff, vocab, n_experts = 2, 32, 4, 64, 17, 4
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(0, vocab, (4, 16)).astype(np.int32)
+    labels = ((tokens + 1) % vocab).astype(np.int32)
+
+    losses = {}
+    for name, shape in (("tp1", {"data": 2, "seq": 2, "model": 1}),
+                        ("tp2", {"data": 2, "seq": 2, "model": 2})):
+        mesh = make_mesh(shape)
+        prng.seed_all(33)
+        params = tfm.init_params(prng.get(), n_layers, d, heads, ff,
+                                 vocab, n_experts=n_experts)
+        step, _ = tfm.make_train_step(mesh, n_layers, d, heads, ff,
+                                      vocab, lr=0.2,
+                                      n_experts=n_experts)
+        run = []
+        for _ in range(15):
+            params, loss = step(params, tokens, labels)
+            run.append(float(loss))
+        losses[name] = run
+    np.testing.assert_allclose(losses["tp2"], losses["tp1"],
+                               rtol=2e-4, atol=2e-5)
+    assert losses["tp1"][-1] < losses["tp1"][0] * 0.6, losses["tp1"]
+
+    # indivisible expert count is refused loudly
+    import pytest
+    with pytest.raises(ValueError, match="n_experts"):
+        tfm.make_train_step(make_mesh({"data": 2, "seq": 2, "model": 2}),
+                            n_layers, d, heads, ff, vocab, n_experts=3)

@@ -11,6 +11,8 @@ planned optimization and slots behind the same function signature.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -38,3 +40,62 @@ def moe_ffn(x, gate_w, w1_local, b1_local, w2_local, b2_local,
     y_e = jnp.einsum("etf,efd->etd", h, w2_local) + b2_local[:, None, :]
     y_local = (y_e * sel[:, :, None]).sum(axis=0) * gate_val[:, None]
     return lax.psum(y_local, axis_name), gate_probs
+
+
+def moe_ffn_dispatch(x, gate_w, w1_local, b1_local, w2_local, b2_local,
+                     act, axis_name: str = "expert",
+                     capacity_factor: float = 2.0):
+    """Token-dispatch MoE FFN for the TOKEN-SHARDED regime (the
+    all_to_all optimization :func:`moe_ffn`'s docstring plans): ``x``
+    ``(tokens_local, d)`` is sharded over ``axis_name`` (each device
+    holds its own tokens AND ``e_local`` experts).  Routed tokens
+    travel to their expert's device and back with two ``lax.all_to_all``
+    exchanges — each token is computed ONCE, by one expert, instead of
+    the dense-masked path's E_local× arithmetic.
+
+    Mesh-TensorFlow dispatch formulation (einsum with a
+    ``(tokens, E, capacity)`` one-hot — MXU-friendly, no scatters):
+    per-expert buckets have ``capacity = ceil(capacity_factor ·
+    tokens_local / E)`` slots per SOURCE device; a token past its
+    expert's capacity is DROPPED (contributes zero output — the
+    standard switch-transformer overflow semantics; size
+    ``capacity_factor`` for the expected imbalance, or set it ≥ E for
+    provably lossless routing).  Gradients flow through both
+    all_to_alls back to x, the gate, and the owning expert's weights.
+
+    Returns ``(y_local (tokens_local, d), gate_probs)`` — both sharded
+    like ``x``."""
+    n_dev = lax.psum(1, axis_name)
+    tokens, d = x.shape
+    e_local = w1_local.shape[0]
+    n_experts = n_dev * e_local
+    scores = x @ gate_w                          # (t, E)
+    gate_probs = jax.nn.softmax(scores, axis=-1)
+    choice = scores.argmax(axis=-1)              # (t,)
+    gate_val = jnp.take_along_axis(gate_probs, choice[:, None],
+                                   axis=1)[:, 0]
+    capacity = int(np.ceil(capacity_factor * tokens / n_experts))
+    onehot = jax.nn.one_hot(choice, n_experts, dtype=jnp.int32)  # (t, E)
+    # arrival order position of each token within its expert's bucket
+    pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
+                              choice[:, None], axis=1)[:, 0]   # (t,) int
+    keep = (pos < capacity).astype(x.dtype)
+    mask = (onehot.astype(x.dtype)[:, :, None] *
+            jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :] *
+            keep[:, None, None])                 # (t, E, C)
+    disp = jnp.einsum("tec,td->ecd", mask, x)    # (E, C, d)
+    # -> (n_dev, e_local, C, d); all_to_all swaps the leading device dim
+    # so each device receives its OWN experts' buckets from every source
+    disp = disp.reshape(n_dev, e_local, capacity, d)
+    recv = lax.all_to_all(disp, axis_name, split_axis=0, concat_axis=0)
+    # expert compute over (n_src * C) tokens per local expert
+    xin = recv.transpose(1, 0, 2, 3).reshape(e_local,
+                                             n_dev * capacity, d)
+    h = act(jnp.einsum("etd,edf->etf", xin, w1_local) +
+            b1_local[:, None, :])
+    y = jnp.einsum("etf,efd->etd", h, w2_local) + b2_local[:, None, :]
+    y = y.reshape(e_local, n_dev, capacity, d).transpose(1, 0, 2, 3)
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
+    comb = back.reshape(n_experts, capacity, d)  # MY tokens' results
+    out = jnp.einsum("tec,ecd->td", mask, comb) * gate_val[:, None]
+    return out, gate_probs

@@ -32,7 +32,8 @@ except ImportError:
 
 from znicz_tpu.parallel.moe import moe_ffn
 from znicz_tpu.parallel.pipeline import pipeline_apply
-from znicz_tpu.parallel.ring_attention import ring_attention
+from znicz_tpu.parallel.ring_attention import (ring_attention,
+                                               ring_flash_attention)
 from znicz_tpu.parallel import tp
 
 
@@ -97,39 +98,67 @@ def _default_compute_dtype(compute_dtype=None):
 
 # -- dp x sp x tp flagship --------------------------------------------------
 def init_params(gen, n_layers: int, d: int, heads: int, ff: int,
-                vocab: int):
-    """Global (unsharded) parameter pytree from the framework PRNG."""
+                vocab: int, n_experts: int | None = None):
+    """Global (unsharded) parameter pytree from the framework PRNG.
+    ``n_experts`` swaps each block's dense FFN for a top-1 MoE FFN
+    (gate + per-expert w1/b1/w2/b2 stacks, expert-sharded over the
+    ``model`` axis at placement time)."""
     def w(shape, scale=None):
-        scale = scale or 1.0 / np.sqrt(shape[0])
+        scale = scale or 1.0 / np.sqrt(shape[-2] if len(shape) > 1
+                                       else shape[0])
         return gen.normal(0.0, scale, shape).astype(np.float32)
 
     blocks = []
     for _ in range(n_layers):
-        blocks.append({
+        blk = {
             "ln1_g": np.ones(d, np.float32), "ln1_b": np.zeros(d, np.float32),
             "wq": w((d, d)), "wk": w((d, d)), "wv": w((d, d)), "wo": w((d, d)),
             "ln2_g": np.ones(d, np.float32), "ln2_b": np.zeros(d, np.float32),
-            "w1": w((d, ff)), "b1": np.zeros(ff, np.float32),
-            "w2": w((ff, d)), "b2": np.zeros(d, np.float32),
-        })
+        }
+        if n_experts:
+            blk.update({
+                "gate": w((d, n_experts)),
+                "ew1": w((n_experts, d, ff)),
+                "eb1": np.zeros((n_experts, ff), np.float32),
+                "ew2": w((n_experts, ff, d)),
+                "eb2": np.zeros((n_experts, d), np.float32),
+            })
+        else:
+            blk.update({
+                "w1": w((d, ff)), "b1": np.zeros(ff, np.float32),
+                "w2": w((ff, d)), "b2": np.zeros(d, np.float32),
+            })
+        blocks.append(blk)
     return {"emb": w((vocab, d), 0.02), "head": w((d, vocab)),
             "blocks": blocks}
 
 
-def param_specs(n_layers: int, head_sharded: bool = False):
+def param_specs(n_layers: int, head_sharded: bool = False,
+                moe: bool = False):
     """PartitionSpecs matching init_params: attention qkv column-sharded,
     wo row-sharded, MLP Megatron-sharded over ``model``; the rest
     replicated.  ``head_sharded`` vocab-shards the LM head over
     ``model`` (Megatron parallel cross-entropy — pair with
-    ``make_train_step(head_sharded=True)``)."""
+    ``make_train_step(head_sharded=True)``).  ``moe`` selects the
+    expert-parallel FFN layout: expert stacks sharded over ``model`` on
+    the expert dim, gate replicated."""
     blk = {
         "ln1_g": P(), "ln1_b": P(),
         "wq": P(None, "model"), "wk": P(None, "model"),
         "wv": P(None, "model"), "wo": P("model", None),
         "ln2_g": P(), "ln2_b": P(),
-        "w1": P(None, "model"), "b1": P("model"),
-        "w2": P("model", None), "b2": P(),
     }
+    if moe:
+        blk.update({
+            "gate": P(),
+            "ew1": P("model", None, None), "eb1": P("model", None),
+            "ew2": P("model", None, None), "eb2": P("model", None),
+        })
+    else:
+        blk.update({
+            "w1": P(None, "model"), "b1": P("model"),
+            "w2": P("model", None), "b2": P(),
+        })
     head = P(None, "model") if head_sharded else P()
     return {"emb": P(), "head": head, "blocks": [dict(blk)] * n_layers}
 
@@ -156,7 +185,6 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
         o = pattn.flash_attention(q, k, v, causal=causal,
                                   interpret=interpret)
     elif use_ring_flash and pattn.supported(t_loc, q.shape[-1]):
-        from znicz_tpu.parallel.ring_attention import ring_flash_attention
         o = ring_flash_attention(q, k, v, "seq", causal=causal,
                                  interpret=interpret)
     else:
@@ -164,17 +192,35 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
     o = o.reshape(b, t_loc, -1)                      # (b, t_loc, d_local)
     x = x + tp.row_parallel(o, p["wo"], None, "model")
     m = _layer_norm(x, p["ln2_g"], p["ln2_b"])
-    x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
-                   jax.nn.gelu, "model")
+    if "ew1" in p:
+        # expert-parallel MoE FFN over the model axis (the block's FFN
+        # capacity scales with experts instead of Megatron-splitting ff)
+        d = m.shape[-1]
+        y2d, _probs = moe_ffn(m.reshape(-1, d), p["gate"], p["ew1"],
+                              p["eb1"], p["ew2"], p["eb2"],
+                              jax.nn.gelu, axis_name="model")
+        x = x + y2d.reshape(m.shape)
+    else:
+        x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
+                       jax.nn.gelu, "model")
     return x
 
 
 def _check_tp(mesh: Mesh, heads: int, d: int, ff: int,
-              vocab_sharded: int | None = None) -> int:
+              vocab_sharded: int | None = None,
+              n_experts: int | None = None) -> int:
     tp_size = mesh.shape["model"]
-    if heads % tp_size or d % tp_size or ff % tp_size:
-        raise ValueError(f"tp={tp_size} must divide heads={heads}, "
-                         f"d={d} and ff={ff}")
+    if heads % tp_size or d % tp_size:
+        raise ValueError(f"tp={tp_size} must divide heads={heads} "
+                         f"and d={d}")
+    # the MoE FFN shards the EXPERT dim, never ff; the dense FFN
+    # Megatron-splits ff
+    if n_experts:
+        if n_experts % tp_size:
+            raise ValueError(f"n_experts={n_experts} must divide by "
+                             f"tp={tp_size}")
+    elif ff % tp_size:
+        raise ValueError(f"tp={tp_size} must divide ff={ff}")
     if vocab_sharded is not None and vocab_sharded % tp_size:
         raise ValueError(f"head_sharded needs vocab={vocab_sharded} "
                          f"divisible by tp={tp_size}")
@@ -329,7 +375,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     compute_dtype=None, shard_update: bool = False,
                     masked: bool = False, donate: bool = False,
                     remat: bool = False, loss_chunks: int | None = None,
-                    head_sharded: bool = False):
+                    head_sharded: bool = False,
+                    n_experts: int | None = None):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -350,6 +397,10 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     (:func:`_vshard_chunk_nll`): head memory, the head GEMM, and its
     gradient all divide by tp, at the cost of one pmax + two psums per
     chunk; composes with ``loss_chunks``.  Requires ``vocab % tp == 0``.
+    ``n_experts=E`` swaps every block's dense FFN for a top-1
+    expert-parallel MoE FFN with the E experts sharded over ``model``
+    (parallel/moe.py; requires ``E % tp == 0``; pass matching
+    ``init_params(..., n_experts=E)`` params).
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -371,8 +422,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     already live partitioned and update locally.
     """
     heads_local = _check_tp(mesh, heads, d, ff,
-                            vocab if head_sharded else None)
-    specs = param_specs(n_layers, head_sharded)
+                            vocab if head_sharded else None, n_experts)
+    specs = param_specs(n_layers, head_sharded, moe=bool(n_experts))
     cdt = _default_compute_dtype(compute_dtype)
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
@@ -447,14 +498,15 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
 def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                    vocab: int, causal: bool = True, compute_dtype=None,
                    masked: bool = False, loss_chunks: int | None = None,
-                   head_sharded: bool = False):
+                   head_sharded: bool = False,
+                   n_experts: int | None = None):
     """-> jitted ``eval_loss(params, tokens, labels[, mask]) -> loss`` —
     the train step's forward + CE loss (the SHARED ``_forward_ce`` body,
     so the numerics cannot drift) with no update: validation/test
     passes."""
     heads_local = _check_tp(mesh, heads, d, ff,
-                            vocab if head_sharded else None)
-    specs = param_specs(n_layers, head_sharded)
+                            vocab if head_sharded else None, n_experts)
+    specs = param_specs(n_layers, head_sharded, moe=bool(n_experts))
     cdt = _default_compute_dtype(compute_dtype)
     from znicz_tpu.core.config import root as root_cfg
     interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
