@@ -459,15 +459,19 @@ def test_moe_ffn_transformer_tp_invariant_and_learns(cpu_devices):
     labels = ((tokens + 1) % vocab).astype(np.int32)
 
     losses = {}
-    for name, shape in (("tp1", {"data": 2, "seq": 2, "model": 1}),
-                        ("tp2", {"data": 2, "seq": 2, "model": 2})):
+    for name, shape, aux_w in (
+            ("tp1", {"data": 2, "seq": 2, "model": 1}, 0.0),
+            ("tp2", {"data": 2, "seq": 2, "model": 2}, 0.0),
+            ("tp1_aux", {"data": 2, "seq": 2, "model": 1}, 0.01),
+            ("tp2_aux", {"data": 2, "seq": 2, "model": 2}, 0.01)):
         mesh = make_mesh(shape)
         prng.seed_all(33)
         params = tfm.init_params(prng.get(), n_layers, d, heads, ff,
                                  vocab, n_experts=n_experts)
         step, _ = tfm.make_train_step(mesh, n_layers, d, heads, ff,
                                       vocab, lr=0.2,
-                                      n_experts=n_experts)
+                                      n_experts=n_experts,
+                                      moe_aux_weight=aux_w)
         run = []
         for _ in range(15):
             params, loss = step(params, tokens, labels)
@@ -475,7 +479,12 @@ def test_moe_ffn_transformer_tp_invariant_and_learns(cpu_devices):
         losses[name] = run
     np.testing.assert_allclose(losses["tp2"], losses["tp1"],
                                rtol=2e-4, atol=2e-5)
+    # the load-balance aux is tp-invariant too, and actually present
+    np.testing.assert_allclose(losses["tp2_aux"], losses["tp1_aux"],
+                               rtol=2e-4, atol=2e-5)
+    assert abs(losses["tp1_aux"][0] - losses["tp1"][0]) > 1e-4
     assert losses["tp1"][-1] < losses["tp1"][0] * 0.6, losses["tp1"]
+    assert losses["tp1_aux"][-1] < losses["tp1_aux"][0] * 0.6
 
     # indivisible expert count is refused loudly
     import pytest

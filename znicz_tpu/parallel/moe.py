@@ -42,6 +42,19 @@ def moe_ffn(x, gate_w, w1_local, b1_local, w2_local, b2_local,
     return lax.psum(y_local, axis_name), gate_probs
 
 
+def load_balance_aux(gate_probs):
+    """Switch-transformer load-balance auxiliary (arXiv:2101.03961
+    eq. 4) over the LOCAL tokens: ``E · Σ_e f_e·P_e`` with ``f`` the
+    top-1 routed fraction (argmax-derived — gradients flow through the
+    mean gate prob ``P`` only) — minimized (=1) at uniform routing.
+    f32 regardless of the compute dtype."""
+    n_exp = gate_probs.shape[-1]
+    pf = gate_probs.astype(jnp.float32)
+    f = jnp.mean(jax.nn.one_hot(pf.argmax(-1), n_exp,
+                                dtype=jnp.float32), axis=0)
+    return n_exp * (f * pf.mean(axis=0)).sum()
+
+
 def moe_ffn_dispatch(x, gate_w, w1_local, b1_local, w2_local, b2_local,
                      act, axis_name: str = "expert",
                      capacity_factor: float = 2.0):

@@ -30,7 +30,7 @@ try:
 except ImportError:
     from jax.experimental.shard_map import shard_map
 
-from znicz_tpu.parallel.moe import moe_ffn
+from znicz_tpu.parallel.moe import load_balance_aux, moe_ffn
 from znicz_tpu.parallel.pipeline import pipeline_apply
 from znicz_tpu.parallel.ring_attention import (ring_attention,
                                                ring_flash_attention)
@@ -196,14 +196,14 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
         # expert-parallel MoE FFN over the model axis (the block's FFN
         # capacity scales with experts instead of Megatron-splitting ff)
         d = m.shape[-1]
-        y2d, _probs = moe_ffn(m.reshape(-1, d), p["gate"], p["ew1"],
-                              p["eb1"], p["ew2"], p["eb2"],
-                              jax.nn.gelu, axis_name="model")
+        y2d, probs = moe_ffn(m.reshape(-1, d), p["gate"], p["ew1"],
+                             p["eb1"], p["ew2"], p["eb2"],
+                             jax.nn.gelu, axis_name="model")
         x = x + y2d.reshape(m.shape)
-    else:
-        x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
-                       jax.nn.gelu, "model")
-    return x
+        return x, load_balance_aux(probs)
+    x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
+                   jax.nn.gelu, "model")
+    return x, jnp.zeros((), jnp.float32)
 
 
 def _check_tp(mesh: Mesh, heads: int, d: int, ff: int,
@@ -307,12 +307,16 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 interp, cdt, remat: bool = False,
                 loss_chunks: int | None = None,
                 use_ring_flash: bool = False,
-                head_sharded: bool = False):
+                head_sharded: bool = False,
+                moe_aux_weight: float = 0.0):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
     contribute neither loss nor — through AD — gradients, the framework's
-    padding contract (loader/base.py)."""
+    padding contract (loader/base.py).  ``moe_aux_weight`` scales the
+    MoE blocks' summed load-balance aux into the loss (local-mean
+    convention, same psum as the CE term; PADDED rows do count toward
+    the routing statistics — the aux is a regularizer, not a metric)."""
     ps = jax.tree.map(lambda w: w.astype(cdt), ps)
     x = ps["emb"][tokens]                         # (b_l, t_l, d)
     blk = _block
@@ -320,9 +324,12 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
         blk = jax.checkpoint(
             _block,
             static_argnums=(2, 3, 4, 5, 6))  # type: ignore[assignment]
+    aux_total = jnp.zeros((), jnp.float32)
     for p in ps["blocks"]:
-        x = blk(x, p, heads_local, causal, use_flash, interp,
-                use_ring_flash)
+        x, aux = blk(x, p, heads_local, causal, use_flash, interp,
+                     use_ring_flash)
+        aux_total = aux_total + aux
+    aux_term = moe_aux_weight * aux_total
     b_l, t_l = labels.shape
     mvec = mask[:, None].astype(jnp.float32) if mask is not None else None
     # either path yields the LOCAL weighted nll sum; normalization below
@@ -345,7 +352,7 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
         # psum-of-local-means; it makes AD emit globally-reduced grads
         # for replicated params; model-sharded params get their local
         # shard's grad
-        return lax.psum(nll / (b_l * t_l), ("data", "seq"))
+        return lax.psum(nll / (b_l * t_l) + aux_term, ("data", "seq"))
     # masked variant, SAME n_shards-scaled convention as the unmasked
     # psum-of-local-means (the caller divides loss and grads by n_shards)
     n_seq = lax.psum(1, "seq")
@@ -355,7 +362,7 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     # joint psum would mix varying and invarying axis states
     total = lax.psum(mask.astype(jnp.float32).sum() * t_l, "data") * n_seq
     return n_shards * lax.psum(nll, ("data", "seq")) / \
-        jnp.maximum(total, 1.0)
+        jnp.maximum(total, 1.0) + lax.psum(aux_term, ("data", "seq"))
 
 
 def _shardmap_kwargs(use_flash: bool, interp: bool) -> dict:
@@ -376,7 +383,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     masked: bool = False, donate: bool = False,
                     remat: bool = False, loss_chunks: int | None = None,
                     head_sharded: bool = False,
-                    n_experts: int | None = None):
+                    n_experts: int | None = None,
+                    moe_aux_weight: float = 0.0):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -400,7 +408,11 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     ``n_experts=E`` swaps every block's dense FFN for a top-1
     expert-parallel MoE FFN with the E experts sharded over ``model``
     (parallel/moe.py; requires ``E % tp == 0``; pass matching
-    ``init_params(..., n_experts=E)`` params).
+    ``init_params(..., n_experts=E)`` params).  ``moe_aux_weight``
+    adds the switch-transformer load-balance aux (arXiv:2101.03961
+    eq. 4, summed over blocks) to the TRAINING loss — without it top-1
+    routing tends to collapse onto few experts; eval losses stay pure
+    CE.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -458,7 +470,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                                causal, use_flash, interp, cdt,
                                remat=remat, loss_chunks=loss_chunks,
                                use_ring_flash=use_ring_flash,
-                               head_sharded=head_sharded)
+                               head_sharded=head_sharded,
+                               moe_aux_weight=moe_aux_weight)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
