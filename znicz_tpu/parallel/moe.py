@@ -1,12 +1,17 @@
 """Expert-parallel mixture-of-experts FFN over the ``expert`` mesh axis
 (TPU-native extension; the reference has no MoE — SURVEY.md §3.4 EP row).
 
-v1 semantics: top-1 gating with dense masked compute — each device runs
-its *local* experts over all tokens, masks by the gate's one-hot choice,
-and a single ``psum`` over the expert axis combines the winners.  This is
-exact top-1 MoE (identical to dispatch-based routing) at the cost of
-E_local x compute per token; an all_to_all token-dispatch path is the
-planned optimization and slots behind the same function signature.
+Two regimes (docs/TUNING.md "MoE"):
+
+- :func:`moe_ffn` — tokens REPLICATED over the expert axis: dense
+  masked compute (each device runs its local experts over all tokens,
+  one psum combines), exact for top-1 switch routing and GShard
+  renormalized top-k, at E_local× arithmetic per token.
+- :func:`moe_ffn_dispatch` — tokens SHARDED over the expert axis: the
+  all_to_all token-dispatch path (each token computes once, on its
+  expert's device; capacity overflow drops, switch semantics).
+
+:func:`load_balance_aux` is the shared switch load-balance regularizer.
 """
 
 from __future__ import annotations
@@ -19,26 +24,35 @@ from jax import lax
 
 
 def moe_ffn(x, gate_w, w1_local, b1_local, w2_local, b2_local,
-            act, axis_name: str = "expert"):
+            act, axis_name: str = "expert", top_k: int = 1):
     """x ``(tokens, d)`` replicated over the expert axis; ``gate_w``
     ``(d, n_experts_total)`` replicated; ``w1_local`` ``(e_local, d, ff)``,
     ``w2_local`` ``(e_local, ff, d)`` expert-sharded.  Returns replicated
     ``(tokens, d)`` plus the (replicated) gate distribution for load-
-    balancing diagnostics."""
+    balancing diagnostics.
+
+    ``top_k=1`` is switch routing (winner scaled by its raw softmax
+    prob); ``top_k≥2`` is GShard-style: the k winners' probs are
+    RENORMALIZED to sum to 1 and their expert outputs combine
+    weighted."""
     my_idx = lax.axis_index(axis_name)
     e_local = w1_local.shape[0]
     scores = x @ gate_w                          # (tokens, E)
     gate_probs = jax.nn.softmax(scores, axis=-1)
-    choice = scores.argmax(axis=-1)              # (tokens,)
+    _, choice_k = lax.top_k(scores, top_k)       # (tokens, k)
+    gate_k = jnp.take_along_axis(gate_probs, choice_k, axis=1)  # (t, k)
+    if top_k > 1:
+        gate_k = gate_k / gate_k.sum(axis=-1, keepdims=True)
     # local expert ids: my_idx*e_local .. +e_local
     local_ids = my_idx * e_local + jnp.arange(e_local)
-    # (e_local, tokens) one-hot of "token routed to this local expert"
-    sel = (choice[None, :] == local_ids[:, None]).astype(x.dtype)
-    gate_val = jnp.take_along_axis(gate_probs, choice[:, None],
-                                   axis=1)[:, 0]  # (tokens,)
+    # (e_local, tokens): this local expert's combined gate weight per
+    # token (0 when the token routed elsewhere)
+    sel = (choice_k[None, :, :] ==
+           local_ids[:, None, None])             # (e_local, t, k)
+    wgt = (sel.astype(x.dtype) * gate_k[None, :, :]).sum(-1)
     h = act(jnp.einsum("td,edf->etf", x, w1_local) + b1_local[:, None, :])
     y_e = jnp.einsum("etf,efd->etd", h, w2_local) + b2_local[:, None, :]
-    y_local = (y_e * sel[:, :, None]).sum(axis=0) * gate_val[:, None]
+    y_local = (y_e * wgt[:, :, None]).sum(axis=0)
     return lax.psum(y_local, axis_name), gate_probs
 
 

@@ -164,7 +164,8 @@ def param_specs(n_layers: int, head_sharded: bool = False,
 
 
 def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
-           interpret: bool = False, use_ring_flash: bool = False):
+           interpret: bool = False, use_ring_flash: bool = False,
+           moe_top_k: int = 1):
     """One transformer block on local shards: ring attention (seq axis)
     with tp-sharded heads, then Megatron MLP (model axis).  With the seq
     axis unsharded, ``use_flash`` swaps the attention core for the Pallas
@@ -198,7 +199,8 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
         d = m.shape[-1]
         y2d, probs = moe_ffn(m.reshape(-1, d), p["gate"], p["ew1"],
                              p["eb1"], p["ew2"], p["eb2"],
-                             jax.nn.gelu, axis_name="model")
+                             jax.nn.gelu, axis_name="model",
+                             top_k=moe_top_k)
         x = x + y2d.reshape(m.shape)
         return x, load_balance_aux(probs)
     x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
@@ -308,7 +310,8 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 loss_chunks: int | None = None,
                 use_ring_flash: bool = False,
                 head_sharded: bool = False,
-                moe_aux_weight: float = 0.0):
+                moe_aux_weight: float = 0.0,
+                moe_top_k: int = 1):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -323,11 +326,11 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     if remat:
         blk = jax.checkpoint(
             _block,
-            static_argnums=(2, 3, 4, 5, 6))  # type: ignore[assignment]
+            static_argnums=(2, 3, 4, 5, 6, 7))  # type: ignore[assignment]
     aux_total = jnp.zeros((), jnp.float32)
     for p in ps["blocks"]:
         x, aux = blk(x, p, heads_local, causal, use_flash, interp,
-                     use_ring_flash)
+                     use_ring_flash, moe_top_k)
         aux_total = aux_total + aux
     aux_term = moe_aux_weight * aux_total
     b_l, t_l = labels.shape
@@ -384,7 +387,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     remat: bool = False, loss_chunks: int | None = None,
                     head_sharded: bool = False,
                     n_experts: int | None = None,
-                    moe_aux_weight: float = 0.0):
+                    moe_aux_weight: float = 0.0,
+                    moe_top_k: int = 1):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -412,7 +416,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     adds the switch-transformer load-balance aux (arXiv:2101.03961
     eq. 4, summed over blocks) to the TRAINING loss — without it top-1
     routing tends to collapse onto few experts; eval losses stay pure
-    CE.
+    CE.  ``moe_top_k=k`` routes each token to its k best experts with
+    GShard-renormalized gate weights (k=1 is switch routing).
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -471,7 +476,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                                remat=remat, loss_chunks=loss_chunks,
                                use_ring_flash=use_ring_flash,
                                head_sharded=head_sharded,
-                               moe_aux_weight=moe_aux_weight)
+                               moe_aux_weight=moe_aux_weight,
+                               moe_top_k=moe_top_k)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
@@ -512,7 +518,8 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                    vocab: int, causal: bool = True, compute_dtype=None,
                    masked: bool = False, loss_chunks: int | None = None,
                    head_sharded: bool = False,
-                   n_experts: int | None = None):
+                   n_experts: int | None = None,
+                   moe_top_k: int = 1):
     """-> jitted ``eval_loss(params, tokens, labels[, mask]) -> loss`` —
     the train step's forward + CE loss (the SHARED ``_forward_ce`` body,
     so the numerics cannot drift) with no update: validation/test
@@ -532,7 +539,8 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                            causal, use_flash, interp, cdt,
                            loss_chunks=loss_chunks,
                            use_ring_flash=use_ring_flash,
-                           head_sharded=head_sharded) / n_shards
+                           head_sharded=head_sharded,
+                           moe_top_k=moe_top_k) / n_shards
 
     batch_spec = P("data", "seq")
     in_specs = (specs, batch_spec, batch_spec) + \
