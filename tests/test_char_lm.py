@@ -166,3 +166,18 @@ def test_char_lm_loss_chunks_trains(tmp_path):
     h = w.decision.metrics_history
     assert h[-1]["metric_validation"] < \
         0.6 * np.log(w.loader.vocab_size)
+
+
+def test_char_lm_moe_trains(tmp_path):
+    """MoE FFN + aux + top-2 routing reachable from the model zoo: the
+    char-LM workflow trains with 4 experts and the CE still collapses."""
+    prng.seed_all(11)
+    w = char_lm.build(max_epochs=3, seq_len=32, minibatch_size=16,
+                      n_layers=2, d=32, heads=2,
+                      data_dir=str(tmp_path / "corp"), n_experts=4,
+                      moe_aux_weight=0.01, moe_top_k=2)
+    w.initialize(device=TPUDevice())
+    w.run()
+    h = w.decision.metrics_history
+    assert h[-1]["metric_validation"] < \
+        0.7 * np.log(w.loader.vocab_size)

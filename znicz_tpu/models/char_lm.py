@@ -22,17 +22,23 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
           valid_fraction: float = 0.1, mesh=None, data_dir: str = "",
           snapshotter_config: dict | None = None,
           loss_chunks: int | None = None,
-          head_sharded: bool = False) -> NNWorkflow:
+          head_sharded: bool = False,
+          n_experts: int | None = None,
+          moe_aux_weight: float = 0.0,
+          moe_top_k: int = 1) -> NNWorkflow:
     w = NNWorkflow(name="CharLM")
     w.repeater = Repeater(w)
     w.loader = CharSequenceLoader(
         w, data_dir=data_dir, seq_len=seq_len,
         minibatch_size=minibatch_size, valid_fraction=valid_fraction)
     # loss_chunks / head_sharded: the vocab≫d levers (docs/TUNING.md) —
-    # chunked rematerialized CE and the Megatron vocab-sharded head
+    # chunked rematerialized CE and the Megatron vocab-sharded head;
+    # n_experts/moe_*: the expert-parallel MoE FFN stack
     step = w.step = TransformerLMStep(
         w, loader=w.loader, n_layers=n_layers, d=d, heads=heads, lr=lr,
-        mesh=mesh, loss_chunks=loss_chunks, head_sharded=head_sharded)
+        mesh=mesh, loss_chunks=loss_chunks, head_sharded=head_sharded,
+        n_experts=n_experts, moe_aux_weight=moe_aux_weight,
+        moe_top_k=moe_top_k)
     dec = w.decision = DecisionMSE(w, max_epochs=max_epochs)
     w.forwards = [step]      # snapshot inventory slot (params live here)
     w.gds = []

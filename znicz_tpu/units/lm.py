@@ -38,7 +38,10 @@ class TransformerLMStep(AcceleratedUnit):
                  d: int = 32, heads: int = 2, ff: Optional[int] = None,
                  lr: float = 0.1, mesh=None,
                  loss_chunks: Optional[int] = None,
-                 head_sharded: bool = False, **kwargs) -> None:
+                 head_sharded: bool = False,
+                 n_experts: Optional[int] = None,
+                 moe_aux_weight: float = 0.0,
+                 moe_top_k: int = 1, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.loader = loader
         self.n_layers = int(n_layers)
@@ -53,6 +56,16 @@ class TransformerLMStep(AcceleratedUnit):
         #: vocab-shard the LM head over the mesh's model axis (Megatron
         #: parallel cross-entropy; vocab must divide by tp)
         self.head_sharded = head_sharded
+        #: MoE FFN blocks: expert count (sharded over the model axis),
+        #: load-balance aux weight (training loss only), and routing k
+        self.n_experts = n_experts
+        self.moe_aux_weight = float(moe_aux_weight)
+        self.moe_top_k = int(moe_top_k)
+        if n_experts is None and (self.moe_aux_weight != 0.0 or
+                                  self.moe_top_k != 1):
+            raise ValueError(
+                "moe_aux_weight/moe_top_k have no effect without "
+                "n_experts — a dense model would train silently")
         self.vocab_size: Optional[int] = None
         # decision links (DecisionMSE contract)
         self.minibatch_mse = 0.0
@@ -82,18 +95,22 @@ class TransformerLMStep(AcceleratedUnit):
         if self._params is None:
             self._params = tfm.init_params(
                 prng.get(), self.n_layers, self.d, self.heads, self.ff,
-                self.vocab_size)
+                self.vocab_size, n_experts=self.n_experts)
         self._params = self._place_params(self._params)
         # masked=True: the loader's padded tail rows (base.py static-shape
         # policy) contribute neither loss nor gradients
         self._step, _ = tfm.make_train_step(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
             self.vocab_size, lr=self.lr, masked=True,
-            loss_chunks=self.loss_chunks, head_sharded=self.head_sharded)
+            loss_chunks=self.loss_chunks, head_sharded=self.head_sharded,
+            n_experts=self.n_experts,
+            moe_aux_weight=self.moe_aux_weight,
+            moe_top_k=self.moe_top_k)
         self._eval = tfm.make_eval_loss(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
             self.vocab_size, masked=True, loss_chunks=self.loss_chunks,
-            head_sharded=self.head_sharded)
+            head_sharded=self.head_sharded, n_experts=self.n_experts,
+            moe_top_k=self.moe_top_k)
         #: minibatch placement: batch over data, time over seq
         self._batch_sharding = NamedSharding(self.mesh, P("data", "seq"))
         self._mask_sharding = NamedSharding(self.mesh, P("data"))
@@ -106,7 +123,8 @@ class TransformerLMStep(AcceleratedUnit):
 
         from znicz_tpu.parallel import transformer as tfm
 
-        specs = tfm.param_specs(self.n_layers, self.head_sharded)
+        specs = tfm.param_specs(self.n_layers, self.head_sharded,
+                                moe=bool(self.n_experts))
         return jax.device_put(
             params, jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), specs,
@@ -159,6 +177,16 @@ class TransformerLMStep(AcceleratedUnit):
                 f"snapshot params (d={params['emb'].shape[1]}, "
                 f"{len(params['blocks'])} blocks) do not match this "
                 f"workflow (d={self.d}, {self.n_layers} blocks)")
+        # the FFN flavor is architecture too: a dense snapshot cannot
+        # restore into an MoE workflow (or vice versa), and the expert
+        # count must match — the params pytree would otherwise win
+        # silently over the configured architecture
+        blk0 = params["blocks"][0]
+        snap_experts = int(blk0["ew1"].shape[0]) if "ew1" in blk0 else None
+        if snap_experts != (self.n_experts or None):
+            raise ValueError(
+                f"snapshot FFN flavor (n_experts={snap_experts}) does "
+                f"not match this workflow (n_experts={self.n_experts})")
         # vocab must match what the loader SERVES NOW — after a restore
         # the loader has adopted the snapshot vocab (CharSequenceLoader
         # snapshots it), so a mismatch means a genuinely different corpus
