@@ -108,6 +108,24 @@ def test_dispatch_capacity_drops_overflow_tokens(cpu_devices):
     assert n_dropped > 0, "test vector never overflowed — regenerate"
 
 
+def _dense_top2_oracle(x, gate, w1, b1, w2, b2):
+    """Single-device GShard top-2 oracle (renormalized combine) shared
+    by the dense-masked and dispatch top-2 parity tests."""
+    E = w1.shape[0]
+    s = x @ gate
+    probs = jax.nn.softmax(s, axis=-1)
+    _, idx = jax.lax.top_k(s, 2)                      # (t, 2)
+    g2 = jnp.take_along_axis(probs, idx, 1)
+    g2 = g2 / g2.sum(-1, keepdims=True)
+    h = jax.nn.gelu(jnp.einsum("td,edf->etf", x, w1) + b1[:, None, :])
+    y_e = jnp.einsum("etf,efd->etd", h, w2) + b2[:, None, :]
+    out = 0.0
+    for k in range(2):
+        sel = jax.nn.one_hot(idx[:, k], E, dtype=x.dtype).T
+        out = out + (y_e * sel[:, :, None]).sum(0) * g2[:, k:k + 1]
+    return out
+
+
 def test_dense_masked_top2_matches_oracle(cpu_devices):
     """moe_ffn top_k=2 (GShard renormalized combine) on the replicated-
     token regime matches a single-device oracle, values and grads, and
@@ -121,21 +139,7 @@ def test_dense_masked_top2_matches_oracle(cpu_devices):
     b1 = jnp.asarray(rng.normal(size=(E, ff)).astype(np.float32))
     w2 = jnp.asarray(rng.normal(size=(E, ff, d)).astype(np.float32) * 0.3)
     b2 = jnp.asarray(rng.normal(size=(E, d)).astype(np.float32))
-
-    def oracle(x, gate, w1, b1, w2, b2):
-        s = x @ gate
-        probs = jax.nn.softmax(s, axis=-1)
-        _, idx = jax.lax.top_k(s, 2)                      # (t, 2)
-        g2 = jnp.take_along_axis(probs, idx, 1)
-        g2 = g2 / g2.sum(-1, keepdims=True)
-        h = jax.nn.gelu(jnp.einsum("td,edf->etf", x, w1) +
-                        b1[:, None, :])
-        y_e = jnp.einsum("etf,efd->etd", h, w2) + b2[:, None, :]
-        out = 0.0
-        for k in range(2):
-            sel = jax.nn.one_hot(idx[:, k], E, dtype=x.dtype).T
-            out = out + (y_e * sel[:, :, None]).sum(0) * g2[:, k:k + 1]
-        return out
+    oracle = _dense_top2_oracle
 
     from znicz_tpu.parallel.moe import moe_ffn
 
@@ -163,3 +167,39 @@ def test_dense_masked_top2_matches_oracle(cpu_devices):
         np.testing.assert_allclose(np.asarray(outs[name]),
                                    np.asarray(y_ref), rtol=2e-5,
                                    atol=2e-5)
+
+
+def test_dispatch_top2_matches_dense_top2_oracle(cpu_devices):
+    """top_k=2 dispatch: each token occupies two bucket slots and the
+    combine is GShard-renormalized — matches the dense top-2 oracle
+    (values + grads) at lossless capacity."""
+    mesh = make_mesh({"expert": 4})
+    n_dev, e_local, d, ff, t_total = 4, 1, 8, 16, 32
+    E = n_dev * e_local
+    rng = np.random.default_rng(11)
+    x, gate, w1, b1, w2, b2 = _setup(rng, n_dev, e_local, d, ff, t_total)
+
+    def local(x, gate, w1, b1, w2, b2):
+        y, _ = moe_ffn_dispatch(x, gate, w1, b1, w2, b2, jax.nn.gelu,
+                                axis_name="expert",
+                                capacity_factor=float(E), top_k=2)
+        return y
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P("expert"), P(), P("expert"), P("expert"),
+                             P("expert"), P("expert")),
+                   out_specs=P("expert"))
+
+    oracle = _dense_top2_oracle
+
+    y = fn(x, gate, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(oracle(x, gate, w1, b1, w2,
+                                                 b2)),
+                               rtol=2e-5, atol=2e-5)
+    g = jax.grad(lambda *a: (fn(*a) ** 2).sum(),
+                 argnums=(0, 1, 2))(x, gate, w1, b1, w2, b2)
+    g_ref = jax.grad(lambda *a: (oracle(*a) ** 2).sum(),
+                     argnums=(0, 1, 2))(x, gate, w1, b1, w2, b2)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)

@@ -71,7 +71,7 @@ def load_balance_aux(gate_probs):
 
 def moe_ffn_dispatch(x, gate_w, w1_local, b1_local, w2_local, b2_local,
                      act, axis_name: str = "expert",
-                     capacity_factor: float = 2.0):
+                     capacity_factor: float = 2.0, top_k: int = 1):
     """Token-dispatch MoE FFN for the TOKEN-SHARDED regime (the
     all_to_all optimization :func:`moe_ffn`'s docstring plans): ``x``
     ``(tokens_local, d)`` is sharded over ``axis_name`` (each device
@@ -83,33 +83,46 @@ def moe_ffn_dispatch(x, gate_w, w1_local, b1_local, w2_local, b2_local,
     Mesh-TensorFlow dispatch formulation (einsum with a
     ``(tokens, E, capacity)`` one-hot — MXU-friendly, no scatters):
     per-expert buckets have ``capacity = ceil(capacity_factor ·
-    tokens_local / E)`` slots per SOURCE device; a token past its
-    expert's capacity is DROPPED (contributes zero output — the
-    standard switch-transformer overflow semantics; size
-    ``capacity_factor`` for the expected imbalance, or set it ≥ E for
-    provably lossless routing).  Gradients flow through both
-    all_to_alls back to x, the gate, and the owning expert's weights.
+    tokens_local · top_k / E)`` slots per SOURCE device; a
+    (token, choice) pair past its expert's capacity is DROPPED
+    (contributes zero output — the standard switch-transformer overflow
+    semantics; size ``capacity_factor`` for the expected imbalance, or
+    set it ≥ E/top_k for provably lossless routing).  Gradients flow
+    through both all_to_alls back to x, the gate, and the owning
+    expert's weights.
 
-    Returns ``(y_local (tokens_local, d), gate_probs)`` — both sharded
-    like ``x``."""
+    ``top_k≥2`` routes each token to its k best experts with
+    GShard-renormalized combine weights (same semantics as
+    :func:`moe_ffn`); the token then occupies up to k bucket slots and
+    ``capacity`` scales by k.  Returns ``(y_local (tokens_local, d),
+    gate_probs)`` — both sharded like ``x``."""
     n_dev = lax.psum(1, axis_name)
     tokens, d = x.shape
     e_local = w1_local.shape[0]
     n_experts = n_dev * e_local
     scores = x @ gate_w                          # (t, E)
     gate_probs = jax.nn.softmax(scores, axis=-1)
-    choice = scores.argmax(axis=-1)              # (t,)
-    gate_val = jnp.take_along_axis(gate_probs, choice[:, None],
-                                   axis=1)[:, 0]
-    capacity = int(np.ceil(capacity_factor * tokens / n_experts))
-    onehot = jax.nn.one_hot(choice, n_experts, dtype=jnp.int32)  # (t, E)
-    # arrival order position of each token within its expert's bucket
+    _, choice_k = lax.top_k(scores, top_k)       # (t, k)
+    gate_k = jnp.take_along_axis(gate_probs, choice_k, axis=1)  # (t, k)
+    if top_k > 1:
+        gate_k = gate_k / gate_k.sum(axis=-1, keepdims=True)
+    capacity = int(np.ceil(capacity_factor * tokens * top_k /
+                           n_experts))
+    # bucket positions over ALL (token, choice) pairs, token-major with
+    # the k choices inner — each pair claims its own slot
+    cf = choice_k.reshape(-1)                    # (t·k,)
+    onehot = jax.nn.one_hot(cf, n_experts, dtype=jnp.int32)  # (t·k, E)
     pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
-                              choice[:, None], axis=1)[:, 0]   # (t,) int
+                              cf[:, None], axis=1)[:, 0]   # (t·k,) int
     keep = (pos < capacity).astype(x.dtype)
-    mask = (onehot.astype(x.dtype)[:, :, None] *
-            jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :] *
-            keep[:, None, None])                 # (t, E, C)
+    # (t·k, E, C) slot one-hots -> (t, k, E, C)
+    mask_k = (onehot.astype(x.dtype)[:, :, None] *
+              jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :] *
+              keep[:, None, None]).reshape(tokens, top_k, n_experts,
+                                           capacity)
+    # slots are distinct across k, so the binary send mask is the sum
+    mask = mask_k.sum(axis=1)                    # (t, E, C) dispatch
+    comb = (mask_k * gate_k[:, :, None, None]).sum(axis=1)  # combine
     disp = jnp.einsum("tec,td->ecd", mask, x)    # (E, C, d)
     # -> (n_dev, e_local, C, d); all_to_all swaps the leading device dim
     # so each device receives its OWN experts' buckets from every source
@@ -123,6 +136,6 @@ def moe_ffn_dispatch(x, gate_w, w1_local, b1_local, w2_local, b2_local,
     y = jnp.einsum("etf,efd->etd", h, w2_local) + b2_local[:, None, :]
     y = y.reshape(e_local, n_dev, capacity, d).transpose(1, 0, 2, 3)
     back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
-    comb = back.reshape(n_experts, capacity, d)  # MY tokens' results
-    out = jnp.einsum("tec,ecd->td", mask, comb) * gate_val[:, None]
+    res = back.reshape(n_experts, capacity, d)   # MY tokens' results
+    out = jnp.einsum("tec,ecd->td", comb, res)
     return out, gate_probs
