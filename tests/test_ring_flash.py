@@ -141,28 +141,33 @@ def test_transformer_ring_flash_forward_matches_ring(cpu_devices):
     rng = np.random.default_rng(7)
     tokens = rng.integers(0, vocab, (2, 256)).astype(np.int32)
     labels = ((tokens + 1) % vocab).astype(np.int32)
-    mesh = make_mesh({"data": 1, "seq": 2, "model": 1})
 
-    losses = {}
-    for name, flags in (
-            ("ring", {"flash_attention": False}),
-            ("ring_flash", {"flash_attention": True,
-                            "pallas_interpret": True,
-                            "ring_flash_interpret": True})):
-        for key, val in flags.items():
-            setattr(root.common.engine, key, val)
-        try:
-            ev = tfm.make_eval_loss(mesh, n_layers, d, heads, ff, vocab)
-            run = []
-            for seed in (13, 29, 57):
-                prng.seed_all(seed)
-                params = tfm.init_params(prng.get(), n_layers, d, heads,
-                                         ff, vocab)
-                run.append(float(ev(params, tokens, labels)))
-            losses[name] = run
-        finally:
-            root.common.engine.flash_attention = True
-            root.common.engine.pallas_interpret = False
-            root.common.engine.ring_flash_interpret = False
-    np.testing.assert_allclose(losses["ring_flash"], losses["ring"],
-                               rtol=1e-4, atol=1e-5)
+    # plain sp, and sp COMPOSED with tp (heads sharded: tp2 leaves one
+    # local head, dh=64 still passes the flash gate)
+    for axes in ({"data": 1, "seq": 2, "model": 1},
+                 {"data": 1, "seq": 2, "model": 2}):
+        mesh = make_mesh(axes)
+        losses = {}
+        for name, flags in (
+                ("ring", {"flash_attention": False}),
+                ("ring_flash", {"flash_attention": True,
+                                "pallas_interpret": True,
+                                "ring_flash_interpret": True})):
+            for key, val in flags.items():
+                setattr(root.common.engine, key, val)
+            try:
+                ev = tfm.make_eval_loss(mesh, n_layers, d, heads, ff,
+                                        vocab)
+                run = []
+                for seed in (13, 29, 57):
+                    prng.seed_all(seed)
+                    params = tfm.init_params(prng.get(), n_layers, d,
+                                             heads, ff, vocab)
+                    run.append(float(ev(params, tokens, labels)))
+                losses[name] = run
+            finally:
+                root.common.engine.flash_attention = True
+                root.common.engine.pallas_interpret = False
+                root.common.engine.ring_flash_interpret = False
+        np.testing.assert_allclose(losses["ring_flash"], losses["ring"],
+                                   rtol=1e-4, atol=1e-5, err_msg=str(axes))
