@@ -1,0 +1,96 @@
+"""Seeded composition fuzz over the transformer step's flag surface:
+every MATH-PRESERVING flag (loss_chunks, head_sharded, remat, donate,
+shard_update) must leave the training trajectory unchanged vs the plain
+step in ANY combination on ANY mesh — pairwise parity is pinned
+elsewhere; this catches interaction bugs between the execution-strategy
+switches.  Model-CHANGING flags (n_experts/top_k/aux) are fuzzed for
+mesh invariance instead (tp1 == tp2 for the same config)."""
+
+import numpy as np
+
+import jax
+
+from znicz_tpu.core import prng
+from znicz_tpu.parallel.mesh import make_mesh
+from znicz_tpu.parallel import transformer as tfm
+
+MESHES = (
+    {"data": 2, "seq": 2, "model": 2},
+    {"data": 4, "seq": 1, "model": 2},
+    {"data": 2, "seq": 1, "model": 1},
+    {"data": 1, "seq": 2, "model": 4},
+)
+
+
+def _run(mesh, masked, tokens, labels, mask, n_steps=3, **kw):
+    n_layers, d, heads, ff, vocab = 2, 32, 4, 64, 16
+    prng.seed_all(41)
+    params = tfm.init_params(prng.get(), n_layers, d, heads, ff, vocab,
+                             n_experts=kw.get("n_experts"))
+    step, _ = tfm.make_train_step(mesh, n_layers, d, heads, ff, vocab,
+                                  lr=0.2, masked=masked, **kw)
+    args = (tokens, labels, mask) if masked else (tokens, labels)
+    run = []
+    for _ in range(n_steps):
+        params, loss = step(params, *args)
+        run.append(float(loss))
+    return run, jax.device_get(jax.tree.leaves(params))
+
+
+def test_math_preserving_flag_combinations(cpu_devices):
+    rng = np.random.default_rng(99)
+    tokens = rng.integers(0, 16, (4, 16)).astype(np.int32)
+    labels = ((tokens + 1) % 16).astype(np.int32)
+    mask = np.array([True, True, True, False])
+
+    baselines = {}   # (mesh_axes, masked) -> (losses, params); the
+                     # baseline is flag-independent so duplicates memoize
+    for trial in range(6):
+        mesh_axes = MESHES[int(rng.integers(len(MESHES)))]
+        masked = bool(rng.integers(2))
+        flags = {
+            "loss_chunks": [None, 2, 3, 5][int(rng.integers(4))],
+            "head_sharded": bool(rng.integers(2)),
+            "remat": bool(rng.integers(2)),
+            "donate": False,   # donation forbids plain-python rebinds
+                               # of the SAME host params; covered by
+                               # test_remat_and_donate_match_baseline
+            "shard_update": bool(rng.integers(2)),
+        }
+        mesh = make_mesh(mesh_axes)
+        key = (tuple(sorted(mesh_axes.items())), masked)
+        if key not in baselines:
+            baselines[key] = _run(mesh, masked, tokens, labels, mask)
+        base, base_p = baselines[key]
+        got, got_p = _run(mesh, masked, tokens, labels, mask, **flags)
+        np.testing.assert_allclose(
+            got, base, rtol=2e-4, atol=2e-5,
+            err_msg=f"trial {trial}: {mesh_axes} masked={masked} {flags}")
+        for a, b in zip(got_p, base_p):
+            np.testing.assert_allclose(
+                a, b, rtol=3e-4, atol=3e-5,
+                err_msg=f"trial {trial}: {mesh_axes} {flags}")
+
+
+def test_model_changing_flags_mesh_invariant(cpu_devices):
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 16, (4, 16)).astype(np.int32)
+    labels = ((tokens + 1) % 16).astype(np.int32)
+    mask = np.array([True, True, False, False])
+
+    for trial in range(3):
+        flags = {
+            "n_experts": int(rng.choice([2, 4])),
+            "moe_top_k": int(rng.integers(1, 3)),
+            "moe_aux_weight": float(rng.choice([0.0, 0.01])),
+            "loss_chunks": [None, 4][int(rng.integers(2))],
+            "head_sharded": bool(rng.integers(2)),
+        }
+        masked = bool(rng.integers(2))
+        a, _ = _run(make_mesh({"data": 2, "seq": 2, "model": 1}),
+                    masked, tokens, labels, mask, **flags)
+        b, _ = _run(make_mesh({"data": 2, "seq": 2, "model": 2}),
+                    masked, tokens, labels, mask, **flags)
+        np.testing.assert_allclose(
+            b, a, rtol=2e-4, atol=2e-5,
+            err_msg=f"trial {trial}: masked={masked} {flags}")
