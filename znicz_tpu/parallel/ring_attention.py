@@ -17,7 +17,6 @@ tests/test_parallel_axes.py::test_ring_attention_matches_dense).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
