@@ -56,6 +56,8 @@ def test_math_preserving_flag_combinations(cpu_devices):
                                # of the SAME host params; covered by
                                # test_remat_and_donate_match_baseline
             "shard_update": bool(rng.integers(2)),
+            "remat_policy":
+                [None, "dots", "nothing"][int(rng.integers(3))],
         }
         mesh = make_mesh(mesh_axes)
         key = (tuple(sorted(mesh_axes.items())), masked)

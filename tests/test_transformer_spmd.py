@@ -290,7 +290,11 @@ def test_remat_and_donate_match_baseline(cpu_devices):
 
     outs = {}
     for name, kw in (("plain", {}), ("remat", {"remat": True}),
-                     ("donate", {"donate": True})):
+                     ("donate", {"donate": True}),
+                     ("remat_dots", {"remat_policy": "dots"}),
+                     ("remat_dnb",
+                      {"remat_policy": "dots_no_batch"}),
+                     ("remat_nothing", {"remat_policy": "nothing"})):
         prng.seed_all(9)
         params = tfm.init_params(prng.get(), n_layers, d, heads, ff,
                                  vocab)
@@ -301,7 +305,8 @@ def test_remat_and_donate_match_baseline(cpu_devices):
         outs[name] = (float(loss),
                       np.asarray(jax.device_get(
                           jax.tree.leaves(params)[0])))
-    for name in ("remat", "donate"):
+    for name in ("remat", "donate", "remat_dots", "remat_dnb",
+                 "remat_nothing"):
         assert outs[name][0] == outs["plain"][0], (name, outs[name][0])
         np.testing.assert_array_equal(outs[name][1], outs["plain"][1])
 

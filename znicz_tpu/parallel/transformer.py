@@ -305,13 +305,27 @@ def _ce_token_nll_sum(x, labels, chunk_nll, n_chunks, weights):
     return totals.sum()
 
 
+#: named selective-remat policies for ``jax.checkpoint`` around each
+#: block: "dots" saves matmul outputs and recomputes the cheap
+#: elementwise chain (the usual sweet spot); "dots_no_batch" saves only
+#: non-batch dots (layernorm stats etc. recompute); "nothing" is full
+#: recompute — the maximum-memory-savings end of the dial
+_REMAT_POLICIES = {
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch":
+        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+}
+
+
 def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 interp, cdt, remat: bool = False,
                 loss_chunks: int | None = None,
                 use_ring_flash: bool = False,
                 head_sharded: bool = False,
                 moe_aux_weight: float = 0.0,
-                moe_top_k: int = 1):
+                moe_top_k: int = 1,
+                remat_policy: str | None = None):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -323,9 +337,10 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     ps = jax.tree.map(lambda w: w.astype(cdt), ps)
     x = ps["emb"][tokens]                         # (b_l, t_l, d)
     blk = _block
-    if remat:
+    if remat or remat_policy:
+        pol = _REMAT_POLICIES[remat_policy] if remat_policy else None
         blk = jax.checkpoint(
-            _block,
+            _block, policy=pol,
             static_argnums=(2, 3, 4, 5, 6, 7))  # type: ignore[assignment]
     aux_total = jnp.zeros((), jnp.float32)
     for p in ps["blocks"]:
@@ -388,7 +403,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     head_sharded: bool = False,
                     n_experts: int | None = None,
                     moe_aux_weight: float = 0.0,
-                    moe_top_k: int = 1):
+                    moe_top_k: int = 1,
+                    remat_policy: str | None = None):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -398,7 +414,10 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     after the call), halving parameter HBM traffic.  ``remat=True``
     wraps each block in ``jax.checkpoint``: backward recomputes block
     activations instead of saving them — the standard long-context
-    trade (HBM for FLOPs) once t grows past what activations fit.
+    trade (HBM for FLOPs) once t grows past what activations fit;
+    ``remat_policy`` ("dots" | "dots_no_batch" | "nothing") selects a
+    SELECTIVE checkpoint policy instead of the all-or-nothing default
+    (implies remat when set).
     ``loss_chunks=k`` computes the CE loss k token-chunks at a time
     (:func:`_ce_token_nll_sum`) so the ``(tokens, vocab)`` f32 logits
     never materialize — the dominant HBM stream when vocab ≫ d.  Loss
@@ -440,6 +459,9 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     """
     heads_local = _check_tp(mesh, heads, d, ff,
                             vocab if head_sharded else None, n_experts)
+    if remat_policy is not None and remat_policy not in _REMAT_POLICIES:
+        raise ValueError(f"remat_policy={remat_policy!r} — choose from "
+                         f"{sorted(_REMAT_POLICIES)}")
     specs = param_specs(n_layers, head_sharded, moe=bool(n_experts))
     cdt = _default_compute_dtype(compute_dtype)
     from znicz_tpu.core.config import root as root_cfg
@@ -477,7 +499,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                                use_ring_flash=use_ring_flash,
                                head_sharded=head_sharded,
                                moe_aux_weight=moe_aux_weight,
-                               moe_top_k=moe_top_k)
+                               moe_top_k=moe_top_k,
+                               remat_policy=remat_policy)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
