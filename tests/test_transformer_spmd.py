@@ -469,6 +469,8 @@ def test_moe_ffn_transformer_tp_invariant_and_learns(cpu_devices):
             ("tp2", {"data": 2, "seq": 2, "model": 2}, 0.0),
             ("tp1_aux", {"data": 2, "seq": 2, "model": 1}, 0.01),
             ("tp2_aux", {"data": 2, "seq": 2, "model": 2}, 0.01)):
+        # the aux legs also carry the router z-loss so BOTH MoE
+        # regularizers ride the tp-invariance pin
         mesh = make_mesh(shape)
         prng.seed_all(33)
         params = tfm.init_params(prng.get(), n_layers, d, heads, ff,
@@ -476,7 +478,8 @@ def test_moe_ffn_transformer_tp_invariant_and_learns(cpu_devices):
         step, _ = tfm.make_train_step(mesh, n_layers, d, heads, ff,
                                       vocab, lr=0.2,
                                       n_experts=n_experts,
-                                      moe_aux_weight=aux_w)
+                                      moe_aux_weight=aux_w,
+                                      moe_zloss_weight=aux_w / 10)
         run = []
         for _ in range(15):
             params, loss = step(params, tokens, labels)

@@ -25,7 +25,8 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
           head_sharded: bool = False,
           n_experts: int | None = None,
           moe_aux_weight: float = 0.0,
-          moe_top_k: int = 1) -> NNWorkflow:
+          moe_top_k: int = 1,
+          moe_zloss_weight: float = 0.0) -> NNWorkflow:
     w = NNWorkflow(name="CharLM")
     w.repeater = Repeater(w)
     w.loader = CharSequenceLoader(
@@ -38,7 +39,7 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
         w, loader=w.loader, n_layers=n_layers, d=d, heads=heads, lr=lr,
         mesh=mesh, loss_chunks=loss_chunks, head_sharded=head_sharded,
         n_experts=n_experts, moe_aux_weight=moe_aux_weight,
-        moe_top_k=moe_top_k)
+        moe_top_k=moe_top_k, moe_zloss_weight=moe_zloss_weight)
     dec = w.decision = DecisionMSE(w, max_epochs=max_epochs)
     w.forwards = [step]      # snapshot inventory slot (params live here)
     w.gds = []

@@ -56,6 +56,15 @@ def moe_ffn(x, gate_w, w1_local, b1_local, w2_local, b2_local,
     return lax.psum(y_local, axis_name), gate_probs
 
 
+def router_z_loss(scores):
+    """ST-MoE router z-loss (arXiv:2202.08906 eq. 5) over the LOCAL
+    tokens: mean of ``logsumexp(scores)²`` — penalizes large router
+    logits, whose drift destabilizes bf16 MoE training long before the
+    balance aux notices.  f32 regardless of compute dtype."""
+    z = jax.nn.logsumexp(scores.astype(jnp.float32), axis=-1)
+    return (z * z).mean()
+
+
 def load_balance_aux(gate_probs):
     """Switch-transformer load-balance auxiliary (arXiv:2101.03961
     eq. 4) over the LOCAL tokens: ``E · Σ_e f_e·P_e`` with ``f`` the

@@ -30,7 +30,8 @@ try:
 except ImportError:
     from jax.experimental.shard_map import shard_map
 
-from znicz_tpu.parallel.moe import load_balance_aux, moe_ffn
+from znicz_tpu.parallel.moe import (load_balance_aux, moe_ffn,
+                                    router_z_loss)
 from znicz_tpu.parallel.pipeline import pipeline_apply
 from znicz_tpu.parallel.ring_attention import (ring_attention,
                                                ring_flash_attention)
@@ -165,7 +166,8 @@ def param_specs(n_layers: int, head_sharded: bool = False,
 
 def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
            interpret: bool = False, use_ring_flash: bool = False,
-           moe_top_k: int = 1):
+           moe_top_k: int = 1, moe_aux_weight: float = 0.0,
+           moe_zloss_weight: float = 0.0):
     """One transformer block on local shards: ring attention (seq axis)
     with tp-sharded heads, then Megatron MLP (model axis).  With the seq
     axis unsharded, ``use_flash`` swaps the attention core for the Pallas
@@ -197,12 +199,20 @@ def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
         # expert-parallel MoE FFN over the model axis (the block's FFN
         # capacity scales with experts instead of Megatron-splitting ff)
         d = m.shape[-1]
-        y2d, probs = moe_ffn(m.reshape(-1, d), p["gate"], p["ew1"],
+        m2d = m.reshape(-1, d)
+        y2d, probs = moe_ffn(m2d, p["gate"], p["ew1"],
                              p["eb1"], p["ew2"], p["eb2"],
                              jax.nn.gelu, axis_name="model",
                              top_k=moe_top_k)
         x = x + y2d.reshape(m.shape)
-        return x, load_balance_aux(probs)
+        # regularizers pre-weighted here (weights are static floats), so
+        # the accumulator upstream stays a single scalar.  The z-loss's
+        # scores GEMM is identical to moe_ffn's internal one — XLA CSEs
+        # them under jit
+        aux = moe_aux_weight * load_balance_aux(probs)
+        if moe_zloss_weight:
+            aux = aux + moe_zloss_weight * router_z_loss(m2d @ p["gate"])
+        return x, aux
     x = x + tp.mlp(m, p["w1"], p["b1"], p["w2"], p["b2"],
                    jax.nn.gelu, "model")
     return x, jnp.zeros((), jnp.float32)
@@ -325,7 +335,8 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 head_sharded: bool = False,
                 moe_aux_weight: float = 0.0,
                 moe_top_k: int = 1,
-                remat_policy: str | None = None):
+                remat_policy: str | None = None,
+                moe_zloss_weight: float = 0.0):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -341,13 +352,15 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
         pol = _REMAT_POLICIES[remat_policy] if remat_policy else None
         blk = jax.checkpoint(
             _block, policy=pol,
-            static_argnums=(2, 3, 4, 5, 6, 7))  # type: ignore[assignment]
-    aux_total = jnp.zeros((), jnp.float32)
+            static_argnums=(2, 3, 4, 5, 6, 7,
+                            8, 9))  # type: ignore[assignment]
+    # regularizer weights apply inside _block (per-block pre-weighted)
+    aux_term = jnp.zeros((), jnp.float32)
     for p in ps["blocks"]:
         x, aux = blk(x, p, heads_local, causal, use_flash, interp,
-                     use_ring_flash, moe_top_k)
-        aux_total = aux_total + aux
-    aux_term = moe_aux_weight * aux_total
+                     use_ring_flash, moe_top_k, moe_aux_weight,
+                     moe_zloss_weight)
+        aux_term = aux_term + aux
     b_l, t_l = labels.shape
     mvec = mask[:, None].astype(jnp.float32) if mask is not None else None
     # either path yields the LOCAL weighted nll sum; normalization below
@@ -404,7 +417,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     n_experts: int | None = None,
                     moe_aux_weight: float = 0.0,
                     moe_top_k: int = 1,
-                    remat_policy: str | None = None):
+                    remat_policy: str | None = None,
+                    moe_zloss_weight: float = 0.0):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -437,6 +451,10 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     routing tends to collapse onto few experts; eval losses stay pure
     CE.  ``moe_top_k=k`` routes each token to its k best experts with
     GShard-renormalized gate weights (k=1 is switch routing).
+    ``moe_zloss_weight`` adds the ST-MoE router z-loss
+    (arXiv:2202.08906 eq. 5) — penalizes router-logit drift, the bf16
+    MoE instability the balance aux does not catch; training loss
+    only, like the balance aux.
 
     ``tokens``/``labels``: int32 ``(batch, time)``, batch sharded over
     ``data`` and time over ``seq``; per-position class targets (CE loss).
@@ -500,7 +518,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                                head_sharded=head_sharded,
                                moe_aux_weight=moe_aux_weight,
                                moe_top_k=moe_top_k,
-                               remat_policy=remat_policy)
+                               remat_policy=remat_policy,
+                               moe_zloss_weight=moe_zloss_weight)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")

@@ -41,7 +41,8 @@ class TransformerLMStep(AcceleratedUnit):
                  head_sharded: bool = False,
                  n_experts: Optional[int] = None,
                  moe_aux_weight: float = 0.0,
-                 moe_top_k: int = 1, **kwargs) -> None:
+                 moe_top_k: int = 1,
+                 moe_zloss_weight: float = 0.0, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.loader = loader
         self.n_layers = int(n_layers)
@@ -61,10 +62,13 @@ class TransformerLMStep(AcceleratedUnit):
         self.n_experts = n_experts
         self.moe_aux_weight = float(moe_aux_weight)
         self.moe_top_k = int(moe_top_k)
+        self.moe_zloss_weight = float(moe_zloss_weight)
         if n_experts is None and (self.moe_aux_weight != 0.0 or
+                                  self.moe_zloss_weight != 0.0 or
                                   self.moe_top_k != 1):
             raise ValueError(
-                "moe_aux_weight/moe_top_k have no effect without "
+                "moe_aux_weight/moe_zloss_weight/moe_top_k have no "
+                "effect without "
                 "n_experts — a dense model would train silently")
         self.vocab_size: Optional[int] = None
         # decision links (DecisionMSE contract)
@@ -105,7 +109,8 @@ class TransformerLMStep(AcceleratedUnit):
             loss_chunks=self.loss_chunks, head_sharded=self.head_sharded,
             n_experts=self.n_experts,
             moe_aux_weight=self.moe_aux_weight,
-            moe_top_k=self.moe_top_k)
+            moe_top_k=self.moe_top_k,
+            moe_zloss_weight=self.moe_zloss_weight)
         self._eval = tfm.make_eval_loss(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
             self.vocab_size, masked=True, loss_chunks=self.loss_chunks,
