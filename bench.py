@@ -516,7 +516,82 @@ def bench_mnist_wallclock(n_train=6000, n_valid=1000, target_pct=1.0,
           synthesized_data=True)
 
 
+def bench_serve(duration_s=4.0, clients=8, max_batch=32):
+    """serve/ plane scenario: threaded clients hammer the in-process
+    micro-batcher + bucketed engine (CPU — this measures the serving
+    machinery, not the chip) and the line reports sustained QPS with the
+    p95 request latency and observed coalescing from the serving
+    metrics.  Zero steady-state recompiles is asserted, not assumed."""
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.serve import BatchEngine, MicroBatcher
+
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.normal(0, 0.1, (64, 256)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(0, 0.1, (256, 16)).astype(np.float32))
+
+    @jax.jit
+    def mlp(x):
+        return jnp.tanh(x @ w1) @ w2
+
+    engine = BatchEngine(mlp, max_batch=max_batch, input_shape=(64,))
+    engine.warmup()
+    compiles = engine.compile_count
+    batcher = MicroBatcher(engine, max_wait_ms=2.0, max_queue=512,
+                           default_timeout_s=60.0)
+    stop_at = time.perf_counter() + duration_s
+    errors = []
+
+    def client(cid):
+        crng = np.random.default_rng(cid)
+        x = crng.normal(size=(1, 64)).astype(np.float32)
+        try:
+            while time.perf_counter() < stop_at:
+                batcher.predict(x)
+        except Exception as exc:  # noqa: BLE001 — surface below
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    elapsed = time.perf_counter() - t0
+    batcher.stop()
+    if errors:
+        raise RuntimeError(f"serve bench clients failed: {errors[:3]}")
+    if engine.compile_count != compiles:
+        raise RuntimeError(
+            f"steady-state recompiled: {compiles} -> {engine.compile_count}")
+    snap = batcher.metrics.snapshot()
+    sizes = {int(k): v for k, v in snap["batch_size_histogram"].items()}
+    mean_batch = sum(k * v for k, v in sizes.items()) / \
+        max(sum(sizes.values()), 1)
+    _emit("serve_engine_qps", snap["completed"] / elapsed,
+          unit="requests/sec",
+          p95_latency_ms=snap["latency"]["p95_ms"],
+          p50_latency_ms=snap["latency"]["p50_ms"],
+          clients=clients, mean_coalesced_batch=round(mean_batch, 2),
+          max_coalesced_batch=max(sizes) if sizes else 0,
+          compile_count=engine.compile_count, cpu=True)
+
+
 def child_main(mode: str) -> None:
+    if mode == "serve":
+        # serving-plane scenario: CPU by design (the parent pins
+        # JAX_PLATFORMS=cpu), measures batcher+engine machinery
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_serve()
+        return
     if mode == "cpu_fallback":
         # the axon sitecustomize pins jax_platforms via jax.config at
         # interpreter start — the env var alone does not stick
@@ -609,6 +684,15 @@ def main():
             # single source of truth — no figures duplicated here)
             r["last_hw_numbers"] = "see docs/BENCH_LOG.md"
             print(json.dumps(r), flush=True)
+
+    # serving-plane scenario: its own CPU child (independent of the chip
+    # pool), BEFORE the final flagship re-emit so the driver's last-line
+    # contract is untouched
+    serve_results, note = _run_child("serve", CPU_TIMEOUT, platform="cpu")
+    if note:
+        notes.append(note)
+    for r in serve_results:
+        print(json.dumps(r), flush=True)
 
     if results:
         # headline by NAME, not position: if the child was killed mid-tail

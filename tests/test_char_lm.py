@@ -3,6 +3,13 @@ the transformer step as a workflow citizen (epochs, VALID passes,
 Decision stopping, snapshot roundtrip) — the beyond-parity model family
 riding the reference's control graph."""
 
+import pytest
+
+# full SPMD training runs on the virtual 8-device CPU mesh take
+# minutes per file; tier-1 (-m 'not slow') must fit its 870 s
+# budget, so these ride the registered slow lane
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from znicz_tpu.core import prng

@@ -13,7 +13,9 @@ the suite stays fast.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 torch = pytest.importorskip("torch")
 import torch.nn.functional as F  # noqa: E402

@@ -72,21 +72,21 @@ def _shard_ring(fn_inner, mesh, **kw):
 def test_ring_flash_matches_dense_and_ring(cpu_devices):
     """ring_flash_attention over a 2-way sharded seq axis == dense
     attention on the full sequence == the dense-local ring path, values
-    AND grads, causal and non-causal.  check_vma=False is the
-    interpret-mode Pallas limitation (transformer.py's long note); the
-    grad parity against the no-pallas ring path is exactly the check
-    that the relaxed psum transposition did not corrupt AD here."""
+    AND grads, causal and non-causal.  The vma relaxation the
+    interpret-mode Pallas path needs comes from the parallel/compat.py
+    shard_map shim; the grad parity against the no-pallas ring path is
+    exactly the check that the relaxed psum transposition did not
+    corrupt AD here."""
     mesh = make_mesh({"data": 1, "seq": 2, "model": 1})
     b, t, h, dh = 1, 512, 2, 64
     rng = np.random.default_rng(5)
     q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, dh))
                            .astype(np.float32)) for _ in range(3))
-    kw = tfm._shardmap_kwargs(True, True)
 
     for causal in (False, True):
         ringf = _shard_ring(
             lambda q, k, v: ring_flash_attention(
-                q, k, v, "seq", causal=causal, interpret=True), mesh, **kw)
+                q, k, v, "seq", causal=causal, interpret=True), mesh)
         ringd = _shard_ring(
             lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
             mesh)

@@ -6,6 +6,13 @@ elsewhere; this catches interaction bugs between the execution-strategy
 switches.  Model-CHANGING flags (n_experts/top_k/aux) are fuzzed for
 mesh invariance instead (tp1 == tp2 for the same config)."""
 
+import pytest
+
+# full SPMD training runs on the virtual 8-device CPU mesh take
+# minutes per file; tier-1 (-m 'not slow') must fit its 870 s
+# budget, so these ride the registered slow lane
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import jax

@@ -2,6 +2,13 @@
 dp x sp x tp train step runs and learns; the dp x pipe x expert step runs
 and learns; both exercise every mesh axis the framework supports."""
 
+import pytest
+
+# full SPMD training runs on the virtual 8-device CPU mesh take
+# minutes per file; tier-1 (-m 'not slow') must fit its 870 s
+# budget, so these ride the registered slow lane
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from znicz_tpu.core import prng

@@ -10,7 +10,10 @@ that the fixed model-zoo stacks never permute.
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.backends import NumpyDevice, TPUDevice

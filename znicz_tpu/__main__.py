@@ -4,6 +4,7 @@
 Usage:
     python -m znicz_tpu <workflow.py> [config.py ...] [options]
     python -m znicz_tpu forge {list,upload,fetch} ...
+    python -m znicz_tpu serve <package.npz> [options]
 
 The workflow file must expose ``run(load, main)`` (every models/ sample
 does); config files are executed Python mutating the global ``root`` tree;
@@ -176,6 +177,12 @@ def main(argv=None) -> int:
         if site:
             print(f"applied site config {site}", file=sys.stderr)
         return forge_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # the micro-batching serving plane over an exported package
+        # (serve/server.py) — no workflow machinery, no site config
+        from znicz_tpu.serve.server import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.coordinator is not None:
         multihost(args.coordinator, args.num_processes, args.process_id)

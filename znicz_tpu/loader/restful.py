@@ -3,12 +3,13 @@
 endpoint that feeds request samples through a trained forward chain and
 returns predictions.
 
-TPU-native design: the server wraps an exported forward package
-(utils/export.py :: ExportedForward — the libZnicz-equivalent inference
-runtime, one jitted function) or any ``array -> array`` callable, NOT a
-live training workflow; serving and training stay decoupled the way the
-reference decoupled libVeles inference from the master process.  Requests
-are padded to the package's compiled batch and answered synchronously.
+Since the serve/ subsystem landed, ``PredictionServer`` is a thin
+compatibility wrapper over :class:`znicz_tpu.serve.engine.BatchEngine`:
+the wire format (``POST /predict`` / ``GET /`` metadata) and the
+synchronous ``predict()`` API are unchanged, but execution pads to the
+engine's bucketed batch shapes, so repeated odd-sized requests stop
+recompiling.  For queueing, backpressure, deadlines and metrics use the
+full plane: :class:`znicz_tpu.serve.server.ServeServer`.
 
     POST /predict  {"input": [[...], ...]}  ->  {"output": [[...], ...]}
     GET  /         -> model metadata JSON
@@ -23,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.serve.engine import BatchEngine
 
 
 class PredictionServer(Logger):
@@ -35,16 +37,14 @@ class PredictionServer(Logger):
 
     def __init__(self, model, port: int = 0, max_batch: int = 1024) -> None:
         super().__init__()
-        if isinstance(model, str):
-            from znicz_tpu.utils.export import ExportedForward
-            model = ExportedForward(model)
-        self.model = model
+        self.engine = BatchEngine(model, max_batch=max_batch)
+        self.model = self.engine.model
         self.port = int(port)
-        self.max_batch = int(max_batch)
-        self.meta = dict(getattr(model, "meta", {}) or {})
+        self.max_batch = self.engine.max_batch
+        self.meta = self.engine.meta
         self.n_requests = 0
-        self._lock = threading.Lock()   # jit dispatch is not reentrant-safe
-        self._httpd = None
+        self._lock = threading.Lock()   # engine.run locks per batch; this
+        self._httpd = None              # one keeps n_requests exact
         self._thread = None
 
     def predict(self, batch) -> np.ndarray:
@@ -55,7 +55,7 @@ class PredictionServer(Logger):
             raise ValueError(f"batch {len(x)} > max_batch {self.max_batch}")
         with self._lock:
             self.n_requests += 1
-            return np.asarray(self.model(x))
+        return self.engine.run(x)
 
     # -- HTTP ----------------------------------------------------------------
     def start(self) -> int:

@@ -62,7 +62,15 @@ def available() -> bool:
 
 
 class NativeForward:
-    """A forward package served by the C++ runtime."""
+    """A forward package served by the C++ runtime.
+
+    Usable directly as a serve/engine.py backend: the C++ op set takes
+    any batch length, so ``static_shapes = False`` tells the engine to
+    skip bucket padding (there is nothing to recompile on this path).
+    """
+
+    #: no per-shape compilation — the engine serves exact batch sizes
+    static_shapes = False
 
     def __init__(self, path: str) -> None:
         L = lib()
@@ -80,6 +88,9 @@ class NativeForward:
         L.znicz_infer_input_shape(self._h, shape)
         self.input_shape = tuple(int(d) for d in shape)
         self.output_numel = int(L.znicz_infer_output_numel(self._h))
+        # serving metadata parity with ExportedForward (GET / reports it)
+        self.meta = {"format": "znicz_tpu.forward", "runtime": "native",
+                     "input_shape": list(self.input_shape)}
 
     def __call__(self, x) -> np.ndarray:
         if not self._h:

@@ -51,10 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:                               # jax >= 0.8
-    from jax import shard_map
-except ImportError:                # older jax
-    from jax.experimental.shard_map import shard_map
+from znicz_tpu.parallel.compat import shard_map
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.config import root
@@ -667,10 +664,17 @@ class FusedTrainStep(Unit):
             loss, metrics = self._loss_and_metrics(
                 out, logits_tail, labels, mask)
             metrics = jax.lax.psum(metrics, "data")
-            return jax.lax.psum(loss, "data"), metrics
+            # LOCAL loss on purpose: the cross-device reduction happens
+            # on the GRADS below.  Differentiating through a psum'd loss
+            # depends on the psum transpose convention (it flips with
+            # the replication checker, see parallel/compat.py) and never
+            # yields replicated params on >1 device — the explicit grad
+            # psum is correct under either convention.
+            return loss, metrics
 
         (_, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(trainable)
+        grads = jax.lax.psum(grads, "data")
         metrics["bs"] = jax.lax.psum(mask.sum(), "data")
         return key, grads, metrics
 

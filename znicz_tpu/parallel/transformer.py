@@ -25,10 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from znicz_tpu.parallel.compat import shard_map
 
 from znicz_tpu.parallel.moe import (load_balance_aux, moe_ffn,
                                     router_z_loss)
@@ -74,8 +71,9 @@ def _ring_flash_eligible(mesh: Mesh, interpret: bool) -> bool:
     kernel runs per ring step on (t_loc × t_loc) blocks and results
     merge by lse weight.  Same ``flash_attention`` flag; compiled TPU
     backends only — interpret mode must be opted into explicitly
-    (``engine.ring_flash_interpret``, used by the parity tests, which
-    also need the relaxed vma checker of :func:`_shardmap_kwargs`)."""
+    (``engine.ring_flash_interpret``, used by the parity tests; the
+    vma checker those runs would trip is disabled by the
+    parallel/compat.py shard_map shim)."""
     from znicz_tpu.core.config import root
     if not bool(root.common.engine.get("flash_attention", True)):
         return False
@@ -396,18 +394,6 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
         jnp.maximum(total, 1.0) + lax.psum(aux_term, ("data", "seq"))
 
 
-def _shardmap_kwargs(use_flash: bool, interp: bool) -> dict:
-    """The Pallas-HLO-interpreter vma workaround (see make_train_step's
-    long note): relax shard_map's replication checker only for
-    interpret-mode flash, under whichever spelling this jax has."""
-    if not (use_flash and interp):
-        return {}
-    import inspect
-    flag = "check_vma" if "check_vma" in \
-        inspect.signature(shard_map).parameters else "check_rep"
-    return {flag: False}
-
-
 def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
                     compute_dtype=None, shard_update: bool = False,
@@ -540,19 +526,16 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                 lambda w, g: w - lr * g / n_shards, params, grads)
         return new_params, loss / n_shards
 
-    # _shardmap_kwargs: the Pallas HLO interpreter's internal
-    # dynamic_slices mix vma'd and unvaried operands, tripping shard_map's
-    # vma checker — a JAX-internal limitation of interpret mode only; the
-    # Mosaic path (real TPU) type-checks fine, so keep checking there.
-    # _flash_eligible only allows interpret-flash on a SINGLETON mesh,
-    # where the relaxed psum transposition is exact.
+    # replication checking is disabled wholesale by the compat shim
+    # (parallel/compat.py) — it false-positives on these psum-composed
+    # updates; _flash_eligible still only allows interpret-flash on a
+    # SINGLETON mesh, where the relaxed psum transposition is exact.
     batch_spec = P("data", "seq")
     in_specs = (specs, batch_spec, batch_spec) + \
         ((P("data"),) if masked else ())
     step = shard_map(
         local_step, mesh=mesh, in_specs=in_specs,
-        out_specs=(specs, P()),
-        **_shardmap_kwargs(use_flash or use_ring_flash, interp))
+        out_specs=(specs, P()))
     return jax.jit(step, donate_argnums=(0,) if donate else ()), specs
 
 
@@ -588,8 +571,7 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     in_specs = (specs, batch_spec, batch_spec) + \
         ((P("data"),) if masked else ())
     fn = shard_map(local_eval, mesh=mesh, in_specs=in_specs,
-                   out_specs=P(),
-                   **_shardmap_kwargs(use_flash or use_ring_flash, interp))
+                   out_specs=P())
     return jax.jit(fn)
 
 

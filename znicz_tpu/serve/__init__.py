@@ -1,0 +1,25 @@
+"""znicz_tpu.serve — dynamic micro-batching inference runtime.
+
+The serving plane between the export runtime (utils/export.py,
+native/infer.py) and HTTP: a bounded request queue with backpressure
+(batcher.py), a bucketed batch engine that never recompiles in steady
+state (engine.py), serving telemetry (metrics.py), and the HTTP front
+end + ``python -m znicz_tpu serve`` CLI (server.py).
+
+Reference lineage: the veles stack split serving (libVeles/libZnicz +
+RESTful loader) from training; this subsystem is that split rebuilt
+throughput-first — device efficiency decoupled from client arrival
+patterns by micro-batching, the way weight-update resharding decouples
+optimizer cost from replica count.
+"""
+
+from znicz_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from znicz_tpu.serve.engine import BatchEngine, bucket_sizes, load_backend
+from znicz_tpu.serve.metrics import LatencyHistogram, ServingMetrics
+from znicz_tpu.serve.server import ServeServer, serve_main
+
+__all__ = [
+    "BatchEngine", "DeadlineExceeded", "LatencyHistogram", "MicroBatcher",
+    "QueueFull", "ServeServer", "ServingMetrics", "bucket_sizes",
+    "load_backend", "serve_main",
+]

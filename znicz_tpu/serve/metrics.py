@@ -1,0 +1,183 @@
+"""Serving telemetry — the observability half of the serving plane
+(reference lineage: the veles web_status dashboard tracked *training*
+progress; a traffic-serving runtime needs the request-side mirror).
+
+Everything is stdlib + O(1) per event: fixed-bucket latency histogram
+(p50/p95/p99 read off the cumulative bucket counts, no per-request
+sample retention), an exact coalesced-batch-size histogram, admission /
+rejection / timeout counters, a queue-depth gauge, and QPS both
+since-start and over a short sliding window.  ``snapshot()`` returns a
+plain JSON-able dict — the wire schema served by ``GET /metrics`` and
+merged into web_status.py's ``/status.json``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+#: Fixed latency bucket upper bounds in milliseconds.  Spanning 0.5 ms
+#: (in-process hits on a warm engine) to 8 s (drain under overload);
+#: requests beyond the last edge land in the +Inf bucket.
+LATENCY_BUCKETS_MS = (
+    0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 4000, 8000)
+
+
+class LatencyHistogram:
+    """Fixed-bucket histogram with percentile estimation.
+
+    Percentiles are linearly interpolated inside the winning bucket
+    (Prometheus ``histogram_quantile`` convention), so accuracy is
+    bounded by bucket width — the standard serving trade-off against
+    unbounded sample storage.
+    """
+
+    def __init__(self, buckets_ms=LATENCY_BUCKETS_MS) -> None:
+        self.edges = tuple(float(b) for b in buckets_ms)
+        self.counts = [0] * (len(self.edges) + 1)   # +1: overflow bucket
+        self.total = 0
+        self.sum_ms = 0.0
+
+    def record(self, latency_s: float) -> None:
+        ms = latency_s * 1000.0
+        i = 0
+        for i, edge in enumerate(self.edges):       # noqa: B007
+            if ms <= edge:
+                break
+        else:
+            i = len(self.edges)
+        self.counts[i] += 1
+        self.total += 1
+        self.sum_ms += ms
+
+    def percentile(self, p: float) -> float:
+        """Estimated ``p``-th percentile in milliseconds (0 when empty)."""
+        if self.total == 0:
+            return 0.0
+        rank = p / 100.0 * self.total
+        seen = 0
+        for i, count in enumerate(self.counts):
+            if count == 0:
+                continue
+            if seen + count >= rank:
+                lo = self.edges[i - 1] if i > 0 else 0.0
+                hi = self.edges[i] if i < len(self.edges) else \
+                    max(self.edges[-1], self.sum_ms / self.total)
+                frac = (rank - seen) / count
+                return lo + (hi - lo) * frac
+            seen += count
+        return self.edges[-1]
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.total,
+            "mean_ms": round(self.sum_ms / self.total, 3) if self.total
+            else 0.0,
+            "p50_ms": round(self.percentile(50), 3),
+            "p95_ms": round(self.percentile(95), 3),
+            "p99_ms": round(self.percentile(99), 3),
+            "buckets_ms": {
+                **{f"{edge:g}": self.counts[i]
+                   for i, edge in enumerate(self.edges)},
+                "+Inf": self.counts[-1],
+            },
+        }
+
+
+class ServingMetrics:
+    """Thread-safe aggregate of one serving plane's counters.
+
+    One instance is shared by the batcher (admission, queue depth,
+    request latency) and the HTTP front end; the engine keeps its own
+    compile/run counters and the server merges both views in
+    ``GET /metrics``.
+    """
+
+    #: sliding-window length for the recent-QPS figure
+    WINDOW_S = 10.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.admitted = 0
+        self.rejected = 0          # backpressure: queue-full fast failures
+        self.timed_out = 0         # deadline expired before service
+        self.completed = 0
+        self.errors = 0            # model/engine raised during service
+        self.queue_depth = 0       # live gauge, maintained by the batcher
+        self.batch_sizes: dict[int, int] = {}   # coalesced batch -> count
+        self.latency = LatencyHistogram()
+        self._recent: deque = deque()           # completion stamps
+
+    # -- event hooks (called by batcher / server) ---------------------------
+    def on_admit(self, n_chunks: int = 1) -> None:
+        with self._lock:
+            self.admitted += 1
+            self.queue_depth += n_chunks
+
+    def on_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def on_dequeue(self, n_chunks: int = 1) -> None:
+        with self._lock:
+            self.queue_depth = max(0, self.queue_depth - n_chunks)
+
+    def on_timeout(self) -> None:
+        with self._lock:
+            self.timed_out += 1
+
+    def on_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def on_batch(self, batch_rows: int) -> None:
+        with self._lock:
+            self.batch_sizes[batch_rows] = \
+                self.batch_sizes.get(batch_rows, 0) + 1
+
+    def on_complete(self, latency_s: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.completed += 1
+            self.latency.record(latency_s)
+            self._recent.append(now)
+            cutoff = now - self.WINDOW_S
+            while self._recent and self._recent[0] < cutoff:
+                self._recent.popleft()
+
+    # -- export -------------------------------------------------------------
+    def qps(self) -> float:
+        """Completions per second over the sliding window (falls back to
+        the since-start average while the window is still filling)."""
+        with self._lock:
+            return self._qps_locked(time.monotonic())
+
+    def _qps_locked(self, now: float) -> float:
+        elapsed = now - self.started_at
+        if elapsed <= 0:
+            return 0.0
+        if elapsed < self.WINDOW_S:
+            return self.completed / elapsed
+        cutoff = now - self.WINDOW_S
+        while self._recent and self._recent[0] < cutoff:
+            self._recent.popleft()
+        return len(self._recent) / self.WINDOW_S
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "uptime_s": round(now - self.started_at, 3),
+                "qps": round(self._qps_locked(now), 3),
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "timed_out": self.timed_out,
+                "completed": self.completed,
+                "errors": self.errors,
+                "queue_depth": self.queue_depth,
+                "batch_size_histogram": {
+                    str(k): v for k, v in sorted(self.batch_sizes.items())},
+                "latency": self.latency.snapshot(),
+            }

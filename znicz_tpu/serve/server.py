@@ -1,0 +1,234 @@
+"""HTTP front end + CLI for the serving plane.
+
+The reference exposed trained models through a RESTful endpoint
+(veles/loader/restful.py) backed by the libZnicz C++ runtime; this is
+the production-shaped rebuild: requests enter a bounded queue, the
+micro-batcher coalesces them into bucketed engine batches, and the
+telemetry needed to operate the thing is one GET away.
+
+    POST /predict   {"input": [[...], ...], "timeout_s": 5}
+                    -> 200 {"output": [...]}
+                    |  400 bad request  | 503 queue full (backpressure)
+                    |  504 deadline exceeded
+    GET  /metrics   -> serving + engine counters (metrics.py schema)
+    GET  /healthz   -> {"status": "ok"}  (200 while accepting traffic)
+    GET  /          -> model metadata (PredictionServer-compatible)
+
+CLI:  python -m znicz_tpu serve <package.npz> [--port N] [--max-batch N]
+          [--max-wait-ms F] [--max-queue N] [--native] [--no-warmup]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from znicz_tpu.serve.engine import BatchEngine, load_backend
+
+
+class ServeServer(Logger):
+    """The assembled serving plane: engine + batcher + HTTP."""
+
+    def __init__(self, model, port: int = 0, max_batch: int | None = None,
+                 max_wait_ms: float = 2.0, max_queue: int = 128,
+                 default_timeout_s: float = 30.0,
+                 warmup: bool = True) -> None:
+        super().__init__()
+        if isinstance(model, BatchEngine):
+            if max_batch is not None and max_batch != model.max_batch:
+                raise ValueError(
+                    f"max_batch={max_batch} conflicts with the supplied "
+                    f"engine's max_batch={model.max_batch}; configure it "
+                    "on the engine")
+            self.engine = model
+        else:
+            self.engine = BatchEngine(
+                model, max_batch=64 if max_batch is None else max_batch)
+        if warmup and self.engine.input_shape is not None:
+            self.engine.warmup()
+        self.batcher = MicroBatcher(self.engine, max_wait_ms=max_wait_ms,
+                                    max_queue=max_queue,
+                                    default_timeout_s=default_timeout_s)
+        self.metrics = self.batcher.metrics
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    # -- payloads ------------------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        """Serving + engine counters — the ``GET /metrics`` document,
+        also registered into web_status.py's ``/status.json``."""
+        return {"serving": self.metrics.snapshot(),
+                "engine": self.engine.stats()}
+
+    def meta_snapshot(self) -> dict:
+        return {"model": self.engine.meta,
+                "n_requests": self.metrics.admitted,
+                "max_batch": self.engine.max_batch}
+
+    # -- HTTP ----------------------------------------------------------------
+    def start(self) -> int:
+        plane = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, doc: dict, headers=()) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    self._reply(200, plane.metrics_snapshot())
+                elif self.path.startswith("/healthz"):
+                    draining = plane.batcher.draining
+                    self._reply(503 if draining else 200,
+                                {"status": "draining" if draining
+                                 else "ok"})
+                else:
+                    self._reply(200, plane.meta_snapshot())
+
+            def do_POST(self):
+                if not self.path.startswith("/predict"):
+                    self._reply(404, {"error": "POST /predict"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n))
+                    future = plane.batcher.submit(
+                        doc["input"], timeout_s=doc.get("timeout_s"))
+                except QueueFull as exc:
+                    self._reply(503, {"error": str(exc)},
+                                headers=(("Retry-After", "1"),))
+                    return
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
+                try:
+                    out = future.result()
+                except DeadlineExceeded as exc:
+                    self._reply(504, {"error": str(exc)})
+                    return
+                except QueueFull as exc:    # non-drain shutdown flushed it
+                    self._reply(503, {"error": str(exc)},
+                                headers=(("Retry-After", "1"),))
+                    return
+                except Exception as exc:  # noqa: BLE001 — engine failure
+                    self._reply(500, {"error": str(exc)})
+                    return
+                self._reply(200, {"output": np.asarray(out).tolist()})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+        self.info(f"serving on http://127.0.0.1:{self.port}/ "
+                  f"(buckets {list(self.engine.buckets)})")
+        return self.port
+
+    def stop(self, drain: bool = True) -> None:
+        """Graceful shutdown, in load-balancer-observable order: the
+        batcher drains FIRST — while it does, ``/healthz`` answers 503
+        "draining" and new ``/predict`` admissions get 503 QueueFull —
+        then the listener closes, and the engine backend is released
+        only if the drain actually finished (a worker still grinding
+        through the queue must not lose its backend mid-batch)."""
+        drained = self.batcher.stop(drain=drain)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if drained:
+            self.engine.close()
+        else:
+            self.warning("drain still in progress past the join timeout;"
+                         " leaving the engine open for the worker")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu serve",
+        description="serve a forward package over HTTP with dynamic "
+                    "micro-batching")
+    p.add_argument("package", help="path to a utils/export.py .npz package")
+    p.add_argument("--port", type=int, default=8080,
+                   help="listen port (0 picks a free one)")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="largest coalesced batch (bucket ceiling)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="how long an underfull batch waits for stragglers")
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="queue bound in chunks; beyond it -> 503")
+    p.add_argument("--timeout-s", type=float, default=30.0,
+                   help="default per-request deadline")
+    p.add_argument("--native", action="store_true",
+                   help="serve through the C++ runtime (no JAX in the "
+                        "request path) when buildable")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-compiling the batch buckets")
+    p.add_argument("--smoke-test", action="store_true",
+                   help="start, serve one self-request, exit (CI probe)")
+    return p
+
+
+def serve_main(argv) -> int:
+    args = build_serve_parser().parse_args(argv)
+    try:
+        backend = load_backend(args.package, prefer_native=args.native)
+    except (OSError, ValueError, RuntimeError) as exc:
+        print(f"serve: cannot load {args.package!r}: {exc}")
+        return 2
+    server = ServeServer(backend, port=args.port, max_batch=args.max_batch,
+                         max_wait_ms=args.max_wait_ms,
+                         max_queue=args.max_queue,
+                         default_timeout_s=args.timeout_s,
+                         warmup=not args.no_warmup)
+    port = server.start()
+    if args.smoke_test:
+        import urllib.request
+
+        shape = server.engine.input_shape or (1,)
+        x = np.zeros((2,) + tuple(shape), np.float32)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"input": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        ok = len(out["output"]) == 2
+        print(json.dumps({"smoke": "ok" if ok else "bad",
+                          "port": port,
+                          "metrics": server.metrics_snapshot()}))
+        server.stop()
+        return 0 if ok else 1
+    # serve until SIGTERM (docker/k8s stop) or Ctrl-C — both drain
+    done = threading.Event()
+    import signal
+
+    prev = signal.signal(signal.SIGTERM, lambda *a: done.set())
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    print("serve: draining...")
+    server.stop()
+    return 0

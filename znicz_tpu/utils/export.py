@@ -65,7 +65,15 @@ def export_forward(workflow, path: str, use_ema: bool = False) -> str:
 
 class ExportedForward:
     """A loaded forward package: jitted inference with no workflow
-    machinery (the libZnicz-equivalent runtime)."""
+    machinery (the libZnicz-equivalent runtime).
+
+    As a serve/engine.py backend it declares ``static_shapes = True``:
+    jit compiles per input shape, so the engine pads requests to its
+    bucketed batch shapes and steady-state serving never recompiles.
+    """
+
+    #: jit-per-shape — the serving engine must pad to fixed buckets
+    static_shapes = True
 
     def __init__(self, path: str) -> None:
         with np.load(path, allow_pickle=False) as zf:

@@ -59,10 +59,7 @@ def make_population_evaluator(step, metric: str = "n_err",
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as PSpec
 
-    try:                               # jax >= 0.8
-        from jax import shard_map
-    except ImportError:                # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+    from znicz_tpu.parallel.compat import shard_map
 
     def local(params, key, hyper_pop, xs, ys, ms, ex, ey, em):
         n_pop = jax.tree.leaves(hyper_pop)[0].shape[0]

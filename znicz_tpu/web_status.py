@@ -25,12 +25,28 @@ class WebStatus(Logger):
     def __init__(self, port: int = 0) -> None:
         super().__init__()
         self.workflows: list = []
+        self.serving: list = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = port
 
     def register(self, workflow) -> "WebStatus":
         self.workflows.append(workflow)
+        return self
+
+    def register_serving(self, name: str, source) -> "WebStatus":
+        """Surface a serving plane's metrics in ``/status.json``.
+
+        ``source``: a ``ServeServer`` (its ``metrics_snapshot``), any
+        object with a ``snapshot()`` (e.g. ``ServingMetrics``), or a
+        zero-arg callable returning a dict.
+        """
+        fn = getattr(source, "metrics_snapshot", None) or \
+            getattr(source, "snapshot", None) or source
+        if not callable(fn):
+            raise TypeError(f"register_serving needs a snapshot source, "
+                            f"got {source!r}")
+        self.serving.append((str(name), fn))
         return self
 
     # -- payload ------------------------------------------------------------
@@ -49,7 +65,16 @@ class WebStatus(Logger):
                     {"name": u.name, "runs": u.timing[0],
                      "time_s": round(u.timing[1], 4)} for u in w.units],
             })
-        return {"workflows": out}
+        serving = {}
+        for name, fn in self.serving:
+            try:
+                serving[name] = fn()
+            except Exception as exc:  # noqa: BLE001 — a dead serving
+                serving[name] = {"error": repr(exc)}   # plane must not
+        doc = {"workflows": out}                       # kill the dashboard
+        if serving:
+            doc["serving"] = serving
+        return doc
 
     # -- server -------------------------------------------------------------
     def start(self) -> int:
