@@ -190,12 +190,15 @@ def test_pallas_stochastic_pool_prng_branch_plumbing():
     patch, valid, _ = pool_ops.patches(np, x, 2, 2, 2, 2, pad_value=0.0)
     n, oh, ow, K, c = patch.shape
     vtile = np.broadcast_to(valid.reshape(1, oh * ow, K), (n, oh * ow, K))
-    from jax.experimental.pallas import tpu as pltpu
+    from znicz_tpu.utils.pallas_hw import tpu_interpret_params
 
+    interp = tpu_interpret_params()
+    if interp is None:
+        pytest.skip("no TPU-emulating pallas interpreter in this jax")
     y, tap = stochastic_pool(
         jnp.asarray(patch.reshape(n * oh * ow, K, c)),
         jnp.asarray(vtile.reshape(n * oh * ow, K)), seed=3,
-        interpret=pltpu.InterpretParams())
+        interpret=interp)
     np.testing.assert_array_equal(np.asarray(tap), 0)
     np.testing.assert_allclose(np.asarray(y),
                                patch.reshape(n * oh * ow, K, c)[:, 0, :],
@@ -576,7 +579,7 @@ def test_pallas_hw_parity_sweep_interpret():
     """The compiled-mode hardware sweep (bench.py::bench_pallas_parity)
     must cover every kernel family and pass fully under the interpreter —
     so a chip-window run can only fail for hardware/lowering reasons."""
-    from znicz_tpu.utils.pallas_hw import run_parity
+    from znicz_tpu.utils.pallas_hw import run_parity, tpu_interpret_params
 
     res = run_parity(interpret=True)
     assert set(res) == {"sgd", "adam", "dropout", "lrn", "fc_gemm",
@@ -584,7 +587,15 @@ def test_pallas_hw_parity_sweep_interpret():
                         "stochastic_pool", "kohonen", "flash_attention",
                         "conv_fwd_bf16", "flash_attention_bf16",
                         "sgd_bf16state"}
-    bad = {k: v for k, v in res.items() if v != "ok"}
+    skipped = {k for k, v in res.items() if v.startswith("skipped:")}
+    if tpu_interpret_params() is None:
+        # pre-InterpretParams jax: exactly the in-kernel-PRNG pair may
+        # skip under the interpreter (they still run compiled on chip)
+        assert skipped <= {"dropout", "stochastic_pool"}, res
+    else:
+        assert not skipped, res
+    bad = {k: v for k, v in res.items()
+           if v != "ok" and k not in skipped}
     assert not bad, bad
 
 

@@ -55,7 +55,11 @@ def test_flash_lse_grads_match_dense_oracle():
 
         lf, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         ld, gd = jax.value_and_grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-        np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+        # the loss is an f32 sum over bh*t*dh ≈ 33k terms: block-wise vs
+        # dense accumulation order alone moves the scalar by ~1.6e-5
+        # relative on some BLAS builds — 3e-5 still pins the math while
+        # tolerating summation-order noise (grads keep their own band)
+        np.testing.assert_allclose(float(lf), float(ld), rtol=3e-5)
         for a, b in zip(gf, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Chaos verify — run the resilience plane's fault-injection suite
+# standalone, INCLUDING the slow soak tests tier-1 deselects:
+#   bash tools/chaos.sh             # full chaos suite
+#   bash tools/chaos.sh -k hang     # one scenario
+# Drives the real code paths (workflow step loop, snapshot save path,
+# serve engine) through znicz_tpu/resilience/faults.py hook sites; see
+# docs/RESILIENCE.md for the fault model and how to add a scenario.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -24,6 +24,7 @@ from typing import Optional
 
 from znicz_tpu.core.plumbing import EndPoint, StartPoint
 from znicz_tpu.core.units import Unit
+from znicz_tpu.resilience.faults import fault_hook
 
 
 class Workflow(Unit):
@@ -37,6 +38,10 @@ class Workflow(Unit):
         self.end_point = EndPoint(self)
         self.device = None
         self._wall_time = 0.0
+        #: monotonically increasing control-graph progress counter (one
+        #: per signal delivery); the resilience supervisor's watchdog
+        #: polls it to detect a hung step
+        self.signals_dispatched = 0
 
     # -- child management ---------------------------------------------------
     def add_unit(self, unit: Unit) -> None:
@@ -110,6 +115,11 @@ class Workflow(Unit):
         self.start_point._signal(None, queue)
         while queue:
             source, target = queue.popleft()
+            # chaos hook: the resilience plane injects crashes/hangs here
+            # (site "workflow.step") so fault tests drive this real loop;
+            # with no plan installed this is a single global None check
+            fault_hook("workflow.step", workflow=self, unit=target)
+            self.signals_dispatched += 1
             target._signal(source, queue)
             if self.end_point.reached:
                 break

@@ -24,6 +24,7 @@ import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, register_loader
+from znicz_tpu.resilience.retry import DEFAULT_IO_RETRY
 from znicz_tpu.loader.normalization import (NormalizerStateMixin,
                                              normalizer_factory)
 
@@ -33,8 +34,7 @@ IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
 SYNTH_VERSION = "1"
 
 
-def _decode(path: str, sample_shape: tuple) -> np.ndarray:
-    """Read + resize one image file to (H, W, C) float32 in [0, 255]."""
+def _decode_once(path: str, sample_shape: tuple) -> np.ndarray:
     from PIL import Image
 
     h, w, c = sample_shape
@@ -46,6 +46,14 @@ def _decode(path: str, sample_shape: tuple) -> np.ndarray:
     if c == 1 and arr.ndim == 2:
         arr = arr[:, :, None]
     return arr
+
+
+def _decode(path: str, sample_shape: tuple) -> np.ndarray:
+    """Read + resize one image file to (H, W, C) float32 in [0, 255].
+    Transient read failures (NFS blips, flaky disks) retry under the
+    shared I/O policy; a genuinely truncated/undecodable file still
+    raises after the attempts are spent."""
+    return DEFAULT_IO_RETRY.call(_decode_once, path, sample_shape)
 
 
 def scan_image_tree(data_dir: str) -> tuple[list, list, list]:

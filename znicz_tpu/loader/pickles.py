@@ -19,6 +19,7 @@ import numpy as np
 from znicz_tpu.core.config import root
 from znicz_tpu.loader.base import register_loader
 from znicz_tpu.loader.fullbatch import FullBatchLoader
+from znicz_tpu.resilience.retry import DEFAULT_IO_RETRY
 from znicz_tpu.loader.normalization import (NormalizerStateMixin,
                                              normalizer_factory)
 
@@ -26,10 +27,16 @@ TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
 VALID_FILE = "test_batch"
 
 
-def _read_batch(path: str, shape: tuple) -> tuple[np.ndarray, np.ndarray]:
-    """One pickle file -> ((N, H, W, C) float32, (N,) int32 labels)."""
+def _read_file(path: str) -> dict:
     with open(path, "rb") as f:
-        d = pickle.load(f, encoding="bytes")
+        return pickle.load(f, encoding="bytes")
+
+
+def _read_batch(path: str, shape: tuple) -> tuple[np.ndarray, np.ndarray]:
+    """One pickle file -> ((N, H, W, C) float32, (N,) int32 labels).
+    The raw read retries transient OSErrors under the shared I/O policy
+    (a malformed pickle is not transient and raises immediately)."""
+    d = DEFAULT_IO_RETRY.call(_read_file, path)
     get = lambda k: d.get(k.encode(), d.get(k))  # noqa: E731
     data = np.asarray(get("data"))
     labels = np.asarray(get("labels"), np.int32)

@@ -13,18 +13,65 @@ full plane: :class:`znicz_tpu.serve.server.ServeServer`.
 
     POST /predict  {"input": [[...], ...]}  ->  {"output": [[...], ...]}
     GET  /         -> model metadata JSON
+
+The client side (``predict_remote``) rides the resilience plane's
+:class:`~znicz_tpu.resilience.retry.RetryPolicy`: connection failures
+and 5xx responses retry with backoff, 4xx (a malformed request will not
+get better) raise immediately.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.resilience.retry import RetryPolicy
 from znicz_tpu.serve.engine import BatchEngine
+
+#: client default: 4 attempts, 0.1 s -> 0.8 s backoff; retries OSError
+#: (URLError's base covers refused/reset connections) — HTTP status
+#: filtering happens in predict_remote, which re-raises 5xx as OSError
+DEFAULT_CLIENT_RETRY = RetryPolicy(max_attempts=4, base_delay=0.1,
+                                   multiplier=2.0, max_delay=2.0,
+                                   retryable=(OSError,), seed=0)
+
+
+def predict_remote(url: str, batch, policy: Optional[RetryPolicy] = None,
+                   timeout: float = 30.0) -> np.ndarray:
+    """RESTful client: ``POST {url}/predict`` with retries.
+
+    Transient failures — refused/reset connections, timeouts, HTTP 5xx
+    (an overloaded server shedding load with 503 is the backpressure
+    design of the serve plane) — retry under ``policy``; HTTP 4xx raises
+    ``ValueError`` immediately.
+    """
+    policy = policy or DEFAULT_CLIENT_RETRY
+    url = url.rstrip("/") + "/predict"
+    body = json.dumps(
+        {"input": np.asarray(batch, np.float32).tolist()}).encode()
+
+    def _call() -> np.ndarray:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return np.asarray(json.load(resp)["output"], np.float32)
+        except urllib.error.HTTPError as exc:
+            if exc.code >= 500:
+                raise OSError(f"server error {exc.code} from {url}") \
+                    from exc
+            raise ValueError(
+                f"request rejected ({exc.code}) by {url}: "
+                f"{exc.read()[:200]!r}") from exc
+
+    return policy.call(_call)
 
 
 class PredictionServer(Logger):

@@ -16,15 +16,25 @@ import os
 
 import jax
 
+from znicz_tpu.resilience.retry import DEFAULT_IO_RETRY
 
-def save_pytree(path: str, params) -> str:
+
+def save_pytree(path: str, params, retry=DEFAULT_IO_RETRY) -> str:
     """Write ``params`` (any pytree of arrays) under ``path`` (a
-    directory; created/overwritten atomically by orbax)."""
+    directory; created/overwritten atomically by orbax).  Transient
+    filesystem failures retry under the shared I/O policy."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckpt:
-        ckpt.save(path, params, force=True)
+
+    def _save() -> None:
+        with ocp.StandardCheckpointer() as ckpt:
+            ckpt.save(path, params, force=True)
+
+    if retry is None:
+        _save()
+    else:
+        retry.call(_save)
     return path
 
 

@@ -24,6 +24,7 @@ import threading
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.resilience.faults import fault_hook
 
 
 def bucket_sizes(max_batch: int) -> tuple:
@@ -121,6 +122,9 @@ class BatchEngine(Logger):
     def run(self, x) -> np.ndarray:
         """Execute one batch: pad to the bucket shape, run the model,
         slice the answer back to the true row count."""
+        # chaos hook (site "serve.run"): injected crashes/hangs exercise
+        # the batcher's error propagation and the server's 5xx path
+        fault_hook("serve.run", engine=self)
         x = np.ascontiguousarray(x, np.float32)
         if x.ndim == 1:
             x = x[None]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -27,8 +28,26 @@ import jax
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.units import Unit
+from znicz_tpu.resilience.faults import fault_hook
+from znicz_tpu.resilience.retry import DEFAULT_IO_RETRY
 
 FORMAT_VERSION = 1
+
+
+class SnapshotCorruptError(ValueError):
+    """Stored checksum does not match the snapshot's content — a torn or
+    bit-rotted snapshot must never be silently resumed from."""
+
+
+def content_checksum(arrays: dict) -> int:
+    """CRC32 over the arrays' names, dtypes, shapes and bytes (sorted key
+    order, so it is independent of dict insertion order)."""
+    crc = 0
+    for key in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[key])
+        head = f"{key}:{arr.dtype.str}:{arr.shape}".encode()
+        crc = zlib.crc32(arr.tobytes(), zlib.crc32(head, crc))
+    return crc & 0xFFFFFFFF
 
 
 # -- state collection -------------------------------------------------------
@@ -151,6 +170,15 @@ def restore_state(workflow, path: str) -> dict:
             raise ValueError(f"snapshot format {meta['format_version']} "
                              f"!= supported {FORMAT_VERSION}")
         arrays = {k: zf[k] for k in zf.files if k != "__meta__"}
+    # poison-snapshot detection (resilience supervisor contract): the
+    # checksum written at save time must match the content read back.
+    # Pre-checksum snapshots (no key) load as before.
+    stored = meta.get("checksum")
+    if stored is not None and int(stored) != content_checksum(arrays):
+        raise SnapshotCorruptError(
+            f"snapshot {path} checksum mismatch: stored {stored}, "
+            f"computed {content_checksum(arrays)} — refusing to resume "
+            f"from a corrupt snapshot")
     # strict key/shape matching: a snapshot from a different architecture
     # must fail loudly, never silently resume from partly-random weights
     state_only = _state_only_units(workflow)
@@ -258,11 +286,53 @@ def restore_state(workflow, path: str) -> dict:
     return meta
 
 
-def write_snapshot(path: str, arrays: dict, meta: dict) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, __meta__=np.array(json.dumps(meta)), **arrays)
-    os.replace(tmp, path)  # atomic publish (no torn snapshot on crash)
+def write_snapshot(path: str, arrays: dict, meta: dict,
+                   retry=DEFAULT_IO_RETRY) -> None:
+    """Crash-safe snapshot write: content checksum into the metadata,
+    temp file + flush + fsync + atomic ``os.replace`` publish (a crash at
+    ANY point leaves either the old snapshot or the new one, never a torn
+    file), flaky-filesystem ``OSError`` s retried under ``retry``."""
+    meta = {**meta, "checksum": content_checksum(arrays)}
+
+    def _write_once() -> None:
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, __meta__=np.array(json.dumps(meta)), **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            # chaos hook (site "snapshot.write"): fires between the
+            # durable temp write and the publish, so an injected failure
+            # aborts the snapshot WITHOUT touching the previously
+            # published one — the invariant the supervisor relies on
+            fault_hook("snapshot.write", path=path)
+            os.replace(tmp, path)  # atomic publish
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)     # never leave stale temp litter
+
+    if retry is None:
+        _write_once()
+    else:
+        retry.call(_write_once)
+
+
+def verify_snapshot(path: str) -> bool:
+    """True iff ``path`` is a readable snapshot whose stored checksum
+    (when present) matches its content.  ANY failure — unreadable zip,
+    truncated member, bad JSON, checksum mismatch — is "invalid": the
+    supervisor treats it as poison and falls back to an older snapshot."""
+    try:
+        with np.load(path, allow_pickle=False) as zf:
+            meta = json.loads(str(zf["__meta__"]))
+            if meta.get("format_version") != FORMAT_VERSION:
+                return False
+            arrays = {k: zf[k] for k in zf.files if k != "__meta__"}
+        stored = meta.get("checksum")
+        return stored is None or int(stored) == content_checksum(arrays)
+    except Exception:  # noqa: BLE001 — corruption surfaces many ways
+        return False
 
 
 # -- units ------------------------------------------------------------------
@@ -322,7 +392,16 @@ class SnapshotterToFile(SnapshotterBase):
         epoch = int(meta["loader"]["epoch_number"])
         path = self.snapshot_path(epoch)
         os.makedirs(self.directory, exist_ok=True)
-        write_snapshot(path, arrays, meta)
+        try:
+            write_snapshot(path, arrays, meta)
+        except OSError as exc:
+            # a snapshot that cannot be written (full/flaky disk, even
+            # after retries) must not kill the training run: the previous
+            # published snapshot stays the resume point.  Injected
+            # crashes (FaultInjected) are not OSError and do propagate.
+            self.error(f"snapshot write failed after retries, keeping "
+                       f"{self.destination!r} as resume point: {exc!r}")
+            return
         # prune only after the new snapshot is durably published — a failed
         # write must never leave the run without a resumable checkpoint
         if not self.keep_all and self.destination and \

@@ -123,6 +123,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  evaluator_config: Optional[dict] = None,
                  decision_config: Optional[dict] = None,
                  snapshotter_config: Optional[dict] = None,
+                 health_config: Optional[dict] = None,
                  fused: bool = True, mesh=None,
                  defer_metrics: bool = True,
                  optimizer: str = "sgd",
@@ -141,6 +142,10 @@ class StandardWorkflow(StandardWorkflowBase):
         self.evaluator_config = dict(evaluator_config or {})
         self.decision_config = dict(decision_config or {})
         self.snapshotter_config = snapshotter_config
+        #: resilience plane: HealthGuard kwargs (``mode`` "skip" |
+        #: "rollback", ``check_grads``, ``store_interval``) + optional
+        #: ``rollback`` sub-dict of NNRollback kwargs; None = no guard
+        self.health_config = health_config
         self.fused = fused
         self.mesh = mesh
         self.defer_metrics = defer_metrics
@@ -176,6 +181,8 @@ class StandardWorkflow(StandardWorkflowBase):
                              f" (0 freezes training; negative flips the "
                              f"gradient sign)")
         self.snapshotter = None
+        self.health_guard = None
+        self.nn_rollback = None
         self.create_workflow()
 
     # -- graph assembly ------------------------------------------------------
@@ -189,6 +196,7 @@ class StandardWorkflow(StandardWorkflowBase):
             self.link_fused_step()
         else:
             self.link_gds()
+        self.link_health()
         self.link_snapshotter()
         # the loop back-edge: exactly ONE provider — the Repeater fires on
         # any signal, so a second edge would double-run each minibatch
@@ -309,6 +317,31 @@ class StandardWorkflow(StandardWorkflowBase):
         else:
             self.decision.link_attrs(step, ("minibatch_mse", "mse"))
         self._tail = self.decision
+
+    def link_health(self) -> None:
+        """Resilience plane: per-step NaN/Inf guard between the metric
+        producers and the snapshotter (a poisoned step must be handled
+        BEFORE it can be snapshotted); no-op when health_config is None."""
+        if self.health_config is None:
+            return
+        from znicz_tpu.resilience.health import HealthGuard
+        from znicz_tpu.units.nn_rollback import NNRollback
+        cfg = dict(self.health_config)
+        rollback_cfg = cfg.pop("rollback", None)
+        guard = self.health_guard = HealthGuard(self, **cfg)
+        guard.link_workflow_state(self)
+        if guard.mode == "rollback":
+            rb = self.nn_rollback = NNRollback(self, **(rollback_cfg or {}))
+            rb.link_workflow_state(self)
+            # the guard forces rollbacks per-step; the unit's own
+            # epoch-gated run still stores last-good on improvement
+            rb.link_from(self._tail)
+            rb.gate_skip = ~self.decision.epoch_ended
+            guard.link_rollback(rb)
+            guard.link_from(rb)
+        else:
+            guard.link_from(self._tail)
+        self._tail = guard
 
     def link_snapshotter(self) -> None:
         """Gated snapshotter side chain (lands with znicz_tpu.snapshotter;

@@ -16,6 +16,43 @@ import numpy as np
 from znicz_tpu.core.units import Unit
 
 
+# -- shared param capture (used by NNRollback and resilience.HealthGuard) ----
+def param_arrays(workflow):
+    """(key, Array) pairs of every host-visible trainable buffer — the
+    same inventory the snapshotter walks (weights/bias + momentum)."""
+    for i, fwd in enumerate(workflow.forwards):
+        for attr in ("weights", "bias"):
+            # three-arg getattr: KohonenTrainer has no bias attribute
+            if getattr(fwd, attr, None):
+                yield f"forward.{i}.{attr}", getattr(fwd, attr)
+    for i, gd in enumerate(getattr(workflow, "gds", []) or []):
+        for attr in ("gradient_weights", "gradient_bias"):
+            if getattr(gd, attr, None):
+                yield f"gd.{i}.{attr}", getattr(gd, attr)
+
+
+def capture_params(workflow) -> dict:
+    """Host copy of the current trainable state (device params synced
+    back first in fused workflows)."""
+    step = getattr(workflow, "step", None)
+    if step is not None and getattr(step, "_params", None) is not None:
+        step.sync_to_units()
+    return {k: np.array(arr.map_read(), copy=True)
+            for k, arr in param_arrays(workflow)}
+
+
+def restore_params(workflow, stored: dict) -> None:
+    """Write a :func:`capture_params` copy back (and re-place it on the
+    device mesh in fused workflows)."""
+    for k, arr in param_arrays(workflow):
+        if k in stored:
+            arr.map_invalidate()
+            arr.mem = stored[k].copy()
+    step = getattr(workflow, "step", None)
+    if step is not None and getattr(step, "_params", None) is not None:
+        step._params = step.gather_params()
+
+
 class NNRollback(Unit):
     """Reference: nn_rollback.py :: NNRollback."""
 
@@ -36,33 +73,11 @@ class NNRollback(Unit):
         return self
 
     # -- state capture (same array inventory as the snapshotter) ------------
-    def _param_arrays(self):
-        w = self.target_workflow
-        for i, fwd in enumerate(w.forwards):
-            for attr in ("weights", "bias"):
-                # three-arg getattr: KohonenTrainer has no bias attribute
-                if getattr(fwd, attr, None):
-                    yield f"forward.{i}.{attr}", getattr(fwd, attr)
-        for i, gd in enumerate(getattr(w, "gds", []) or []):
-            for attr in ("gradient_weights", "gradient_bias"):
-                if getattr(gd, attr, None):
-                    yield f"gd.{i}.{attr}", getattr(gd, attr)
-
     def _store_good(self) -> None:
-        step = getattr(self.target_workflow, "step", None)
-        if step is not None and getattr(step, "_params", None) is not None:
-            step.sync_to_units()
-        self._good = {k: np.array(arr.map_read(), copy=True)
-                      for k, arr in self._param_arrays()}
+        self._good = capture_params(self.target_workflow)
 
     def _restore_good(self) -> None:
-        for k, arr in self._param_arrays():
-            if k in self._good:
-                arr.map_invalidate()
-                arr.mem = self._good[k].copy()
-        step = getattr(self.target_workflow, "step", None)
-        if step is not None and getattr(step, "_params", None) is not None:
-            step._params = step.gather_params()
+        restore_params(self.target_workflow, self._good)
 
     def _metric_is_finite(self) -> bool:
         for m in self.decision.epoch_metrics:
@@ -81,13 +96,20 @@ class NNRollback(Unit):
         self._bad_epochs += 1
         if not self._metric_is_finite() or \
                 self._bad_epochs >= self.fail_iterations:
-            if self._good:
-                self._restore_good()
-            for gd in getattr(self.target_workflow, "gds", []) or []:
-                gd.learning_rate = float(gd.learning_rate) * self.lr_cut
-                gd.learning_rate_bias = \
-                    float(gd.learning_rate_bias) * self.lr_cut
-            self._bad_epochs = 0
-            self.rollback_count += 1
-            self.info(f"rollback #{self.rollback_count}: restored last-good "
-                      f"weights, lr cut by {self.lr_cut}")
+            self.force_rollback()
+
+    def force_rollback(self) -> None:
+        """Restore last-good state and cut the learning rates now —
+        called by ``run`` on epoch-level divergence, and by the
+        resilience plane's :class:`~znicz_tpu.resilience.health
+        .HealthGuard` (mode="rollback") on a per-step NaN trip."""
+        if self._good:
+            self._restore_good()
+        for gd in getattr(self.target_workflow, "gds", []) or []:
+            gd.learning_rate = float(gd.learning_rate) * self.lr_cut
+            gd.learning_rate_bias = \
+                float(gd.learning_rate_bias) * self.lr_cut
+        self._bad_epochs = 0
+        self.rollback_count += 1
+        self.info(f"rollback #{self.rollback_count}: restored last-good "
+                  f"weights, lr cut by {self.lr_cut}")

@@ -137,26 +137,36 @@ class Manhole(Logger):
                              daemon=True, name="manhole-conn").start()
 
     def _session(self, conn: socket.socket) -> None:
-        f = conn.makefile("rw", encoding="utf-8", newline="\n")
+        # SEPARATE reader and writer: a single makefile("rw") wraps one
+        # TextIOWrapper whose write() discards its decoded read-ahead
+        # buffer, so commands sent in one burst vanished after the first
+        # response was echoed (lost "x = ..." lines, observed in tests)
+        rf = conn.makefile("r", encoding="utf-8", newline="\n")
+        wf = conn.makefile("w", encoding="utf-8", newline="\n")
         try:
             names = [n for n in sorted(self.namespace)
                      if not n.startswith("_")]       # hide _, __builtins__
-            f.write(BANNER % ", ".join(names) + PROMPT)
-            f.flush()
-            for line in f:
+            wf.write(BANNER % ", ".join(names) + PROMPT)
+            wf.flush()
+            for line in rf:
                 line = line.rstrip("\r\n")
                 if line in ("exit()", "quit()", "\x04"):
                     break
                 out = self._run(line)
                 if out:
-                    f.write(out if out.endswith("\n") else out + "\n")
-                f.write(PROMPT)
-                f.flush()
+                    wf.write(out if out.endswith("\n") else out + "\n")
+                wf.write(PROMPT)
+                wf.flush()
         except (OSError, ValueError):
             pass                                         # client went away
         finally:
+            # separate suppressions: wf.close() flushing into a dead
+            # client raises, and that must not leak the socket fd
             with contextlib.suppress(OSError):
-                f.close()
+                rf.close()
+            with contextlib.suppress(OSError):
+                wf.close()
+            with contextlib.suppress(OSError):
                 conn.close()
 
     def _run(self, line: str) -> str:

@@ -19,10 +19,32 @@ from __future__ import annotations
 import numpy as np
 
 
+class SkipKernel(Exception):
+    """A kernel that cannot run in THIS environment (not a failure):
+    e.g. PRNG-drawing kernels under a jax whose pallas has no
+    TPU-emulating interpreter.  Never raised in compiled mode."""
+
+
+def tpu_interpret_params():
+    """The TPU-emulating pallas interpreter params (needed off-chip for
+    kernels that draw in-kernel PRNG bits — plain ``interpret=True`` has
+    no ``prng_seed`` rule).  The class name moved across jax versions;
+    returns None when this jax has none (jax <= 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    for name in ("InterpretParams", "TPUInterpretParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            return cls()
+    return None
+
+
 def _check(name, fn, results):
     try:
         fn()
         results[name] = "ok"
+    except SkipKernel as exc:
+        results[name] = f"skipped: {exc}"[:200]
     except Exception as exc:  # noqa: BLE001 — a sweep must finish
         results[name] = f"FAIL: {exc!r}"[:200]
 
@@ -66,11 +88,18 @@ def run_parity(interpret: bool = False) -> dict:
                                        rtol=1e-5, atol=1e-6)
 
     # kernels that draw in-kernel PRNG bits need the TPU-emulating
-    # interpreter off-chip (plain interpret=True has no prng_seed rule)
-    from jax.experimental.pallas import tpu as pltpu
-    prng_interp = pltpu.InterpretParams() if interpret else False
+    # interpreter off-chip (plain interpret=True has no prng_seed rule);
+    # on a jax without one they SKIP in interpret mode (still run
+    # compiled on hardware, where interpret=False)
+    prng_interp = tpu_interpret_params() if interpret else False
+
+    def _need_prng_interp():
+        if interpret and prng_interp is None:
+            raise SkipKernel("no TPU-emulating pallas interpreter in "
+                             "this jax (pre-InterpretParams)")
 
     def dropout():
+        _need_prng_interp()
         x = jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
         ratio = 0.4
         y, mask = pk.dropout_forward(x, seed=7, ratio=ratio,
@@ -148,6 +177,7 @@ def run_parity(interpret: bool = False) -> dict:
                                        rtol=1e-4, atol=1e-3)
 
     def stochastic_pool():
+        _need_prng_interp()
         x = rng.normal(size=(4, 16, 16, 128)).astype(np.float32)
         patch, valid, _ = pool_ops.patches(np, x, 2, 2, 2, 2,
                                            pad_value=0.0)

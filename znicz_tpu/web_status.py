@@ -26,6 +26,7 @@ class WebStatus(Logger):
         super().__init__()
         self.workflows: list = []
         self.serving: list = []
+        self.health: list = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = port
@@ -49,6 +50,18 @@ class WebStatus(Logger):
         self.serving.append((str(name), fn))
         return self
 
+    def register_health(self, name: str, guard) -> "WebStatus":
+        """Surface a resilience guard's trip counters in ``/status.json``
+        (next to the serving metrics): ``guard`` is a
+        :class:`~znicz_tpu.resilience.health.HealthGuard`, anything with
+        a ``snapshot()``, or a zero-arg callable returning a dict."""
+        fn = getattr(guard, "snapshot", None) or guard
+        if not callable(fn):
+            raise TypeError(f"register_health needs a snapshot source, "
+                            f"got {guard!r}")
+        self.health.append((str(name), fn))
+        return self
+
     # -- payload ------------------------------------------------------------
     def snapshot(self) -> dict:
         out = []
@@ -65,15 +78,17 @@ class WebStatus(Logger):
                     {"name": u.name, "runs": u.timing[0],
                      "time_s": round(u.timing[1], 4)} for u in w.units],
             })
-        serving = {}
-        for name, fn in self.serving:
-            try:
-                serving[name] = fn()
-            except Exception as exc:  # noqa: BLE001 — a dead serving
-                serving[name] = {"error": repr(exc)}   # plane must not
-        doc = {"workflows": out}                       # kill the dashboard
-        if serving:
-            doc["serving"] = serving
+        doc = {"workflows": out}
+        for key, sources in (("serving", self.serving),
+                             ("health", self.health)):
+            section = {}
+            for name, fn in sources:
+                try:
+                    section[name] = fn()
+                except Exception as exc:  # noqa: BLE001 — a dead plane
+                    section[name] = {"error": repr(exc)}  # must not kill
+            if section:                                   # the dashboard
+                doc[key] = section
         return doc
 
     # -- server -------------------------------------------------------------
