@@ -637,7 +637,94 @@ def bench_serve(duration_s=4.0, clients=8, max_batch=32):
           compile_count=engine.compile_count, cpu=True)
 
 
+def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
+                         n_valid=2560, hidden=512, reps=2):
+    """Input-pipeline scenario (ISSUE 4): sync vs prefetch=2 through the
+    REAL Workflow.run loop on the mnist_fc shape (CPU by design — it
+    measures the prefetch/staging machinery, not the chip).  Dataset
+    pinning is disabled so every step ships its minibatch — the path the
+    pipeline overlaps; the line reports samples/sec for both modes and
+    the per-stage stall breakdown.  The bit-exactness contract is
+    ASSERTED after the line flushes: a determinism break still lands the
+    result but fails the scenario loudly (nonzero child exit)."""
+    import time as _time
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": hidden},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    ]
+    loader_cfg = {"n_classes": 10, "sample_shape": (28, 28),
+                  "n_train": n_train, "n_valid": n_valid,
+                  "minibatch_size": minibatch, "spread": 2.5, "noise": 1.0}
+
+    def run_once(depth):
+        prng.seed_all(7)
+        w = StandardWorkflow(
+            name=f"pipe{depth or 0}", layers=layers,
+            loss_function="softmax", loader_name="synthetic_classifier",
+            loader_config=loader_cfg,
+            decision_config={"max_epochs": epochs},
+            pipeline_config={"depth": depth} if depth else None)
+        w.initialize(device=TPUDevice())
+        t0 = _time.perf_counter()
+        w.run()
+        dt = _time.perf_counter() - t0
+        hist = w.decision.metrics_history
+        stats = w.input_pipeline.stats.snapshot() if depth else None
+        w.stop()
+        return (n_train + n_valid) * epochs / dt, hist, stats
+
+    prev_limit = root.common.engine.get("dataset_on_device_max_bytes",
+                                        1 << 30)
+    root.common.engine.dataset_on_device_max_bytes = 0
+    try:
+        # sync first: its compiles also warm the persistent cache, so any
+        # residual compile bias favors neither mode by the best-of-reps
+        sync_sps, sync_hist = 0.0, None
+        for _ in range(reps):
+            sps, sync_hist, _ = run_once(None)
+            sync_sps = max(sync_sps, sps)
+        pre_sps, pre_hist, pre_stats = 0.0, None, None
+        for _ in range(reps):
+            sps, pre_hist, stats = run_once(2)
+            if sps > pre_sps:
+                pre_sps, pre_stats = sps, stats
+    finally:
+        root.common.engine.dataset_on_device_max_bytes = prev_limit
+    _emit("input_pipeline_mnist_fc_prefetch2_samples_per_sec", pre_sps,
+          cpu=True, sync_samples_per_sec=round(sync_sps, 1),
+          speedup=round(pre_sps / sync_sps, 3),
+          bit_exact=pre_hist == sync_hist,
+          prefetch_depth=2, epochs=epochs,
+          stalls={k: pre_stats[k] for k in
+                  ("serve_s", "stage_s", "producer_starved_s",
+                   "consumer_starved_s", "barrier_s")},
+          bytes_staged=pre_stats["bytes_staged"],
+          bound=pre_stats["bound"])
+    # AFTER the emit so the throughput line always lands: a determinism
+    # break must fail the scenario loudly, not ride a JSON field nobody
+    # greps
+    assert pre_hist == sync_hist, \
+        "prefetched metric history diverged from the synchronous run"
+
+
 def child_main(mode: str) -> None:
+    if mode == "pipeline":
+        # input-pipeline scenario: CPU by design (measures the prefetch
+        # + staging machinery through the real run loop)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_input_pipeline()
+        return
     if mode == "serve":
         # serving-plane scenario: CPU by design (the parent pins
         # JAX_PLATFORMS=cpu), measures batcher+engine machinery
@@ -743,14 +830,16 @@ def main():
                 r["last_hw"] = last_hw
             print(json.dumps(r), flush=True)
 
-    # serving-plane scenario: its own CPU child (independent of the chip
-    # pool), BEFORE the final flagship re-emit so the driver's last-line
-    # contract is untouched
-    serve_results, note = _run_child("serve", CPU_TIMEOUT, platform="cpu")
-    if note:
-        notes.append(note)
-    for r in serve_results:
-        print(json.dumps(r), flush=True)
+    # serving-plane + input-pipeline scenarios: their own CPU children
+    # (independent of the chip pool), BEFORE the final flagship re-emit
+    # so the driver's last-line contract is untouched
+    for extra_mode in ("serve", "pipeline"):
+        extra_results, note = _run_child(extra_mode, CPU_TIMEOUT,
+                                         platform="cpu")
+        if note:
+            notes.append(note)
+        for r in extra_results:
+            print(json.dumps(r), flush=True)
 
     if results:
         # headline by NAME, not position: if the child was killed mid-tail

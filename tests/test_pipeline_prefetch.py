@@ -1,0 +1,314 @@
+"""Async input pipeline (znicz_tpu/pipeline/): the prefetching producer +
+overlapped H2D staging must be INVISIBLE to training semantics — bit-exact
+metric histories vs the synchronous path in every feeding mode (direct
+transfers, HBM-pinned indices, epoch-scan), bit-exact chaos
+kill-and-resume through the resilience plane (drain-on-snapshot barrier),
+bounded-queue backpressure, clean shutdown, and zero steady-state
+recompiles on the step hot path."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import NumpyDevice, TPUDevice
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.synthetic import SyntheticClassifierLoader
+from znicz_tpu.pipeline import (BatchPrefetcher, PrefetcherStopped,
+                                attach_prefetcher)
+from znicz_tpu.resilience import faults
+from znicz_tpu.resilience.supervisor import SupervisorPolicy, run_supervised
+from znicz_tpu.standard_workflow import StandardWorkflow
+from znicz_tpu.web_status import WebStatus
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 6},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+]
+LOADER = {"n_classes": 6, "sample_shape": (10, 10), "n_train": 240,
+          "n_valid": 120, "minibatch_size": 40, "spread": 2.5, "noise": 1.0}
+
+
+def build(max_epochs, snap_dir=None, seed=77, depth=None):
+    """Fresh, initialized workflow (the supervisor's factory discipline:
+    re-seed the global PRNG exactly like a fresh process would)."""
+    prng.seed_all(seed)
+    cfg = None
+    if snap_dir is not None:
+        cfg = {"directory": str(snap_dir), "prefix": "t",
+               "only_improved": False, "keep_all": True}
+    w = StandardWorkflow(
+        name="PipeTest", layers=LAYERS, loss_function="softmax",
+        loader_name="synthetic_classifier", loader_config=LOADER,
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=cfg,
+        pipeline_config={"depth": depth} if depth else None)
+    w.initialize(device=TPUDevice())
+    return w
+
+
+def run_history(max_epochs, depth=None, **kw):
+    w = build(max_epochs, depth=depth, **kw)
+    w.run()
+    hist = w.decision.metrics_history
+    w.stop()
+    return hist, w
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture
+def direct_transfers():
+    """Force the batch-shipping path (no HBM dataset pinning) so the
+    pipeline's staging leg actually carries the minibatches."""
+    prev = root.common.engine.get("dataset_on_device_max_bytes", 1 << 30)
+    root.common.engine.dataset_on_device_max_bytes = 0
+    yield
+    root.common.engine.dataset_on_device_max_bytes = prev
+
+
+def fast_policy(**kw):
+    kw.setdefault("sleep", lambda s: None)
+    return SupervisorPolicy(**kw)
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name == BatchPrefetcher.THREAD_NAME and t.is_alive()]
+
+
+# -- determinism: sync vs prefetched ----------------------------------------
+
+def test_prefetch_bit_exact_direct_mode(direct_transfers):
+    """ISSUE 4 acceptance: with prefetch depth >= 2 the epoch metric
+    histories are bit-identical to the synchronous path (seeded, multiple
+    epochs) — here over the direct batch-transfer feeding mode."""
+    sync_hist, _ = run_history(4)
+    for depth in (2, 3):
+        hist, w = run_history(4, depth=depth)
+        assert hist == sync_hist, f"depth={depth} diverged"
+        snap = w.input_pipeline.stats.snapshot()
+        assert snap["consumed"] == 4 * 9     # 6 train + 3 valid per epoch
+        assert snap["bytes_staged"] > 0      # the staging leg really ran
+        assert snap["max_fill"] <= depth
+
+
+def test_prefetch_bit_exact_indexed_mode():
+    """HBM-pinned dataset (serve_indices_only): the pipeline stages only
+    indices + mask; histories still bit-exact."""
+    sync_hist, ws = run_history(3)
+    hist, wp = run_history(3, depth=2)
+    assert ws.loader.serve_indices_only and wp.loader.serve_indices_only
+    assert hist == sync_hist
+    assert wp.input_pipeline.stats.snapshot()["bytes_staged"] > 0
+
+
+def test_prefetch_bit_exact_scan_epoch_mode():
+    """Epoch-scan feeding (one compiled scan per class pass): the consumer
+    replays the captured class plan from the producer; bit-exact."""
+    prev = root.common.engine.get("scan_epoch", False)
+    root.common.engine.scan_epoch = True
+    try:
+        sync_hist, _ = run_history(3)
+        hist, _ = run_history(3, depth=2)
+    finally:
+        root.common.engine.scan_epoch = prev
+    assert hist == sync_hist
+
+
+def test_pipeline_requires_fused():
+    with pytest.raises(ValueError, match="fused=True"):
+        StandardWorkflow(
+            name="Bad", layers=LAYERS, loss_function="softmax",
+            loader_name="synthetic_classifier", loader_config=LOADER,
+            fused=False, pipeline_config={"depth": 2})
+
+
+# -- resilience interop ------------------------------------------------------
+
+def test_chaos_kill_and_resume_bit_exact_pipelined(tmp_path,
+                                                   direct_transfers):
+    """ISSUE 4 acceptance: a pipelined run killed at a seeded epoch and
+    auto-resumed by the supervisor reproduces the SYNCHRONOUS run's
+    metric history bit-exactly — the epoch-boundary barrier guarantees
+    snapshots capture sync-mode loader/prng state, and restore drains +
+    reseeds the pipeline."""
+    sync_hist, _ = run_history(4)
+
+    rng = np.random.default_rng(1234)
+    crash_epoch = int(rng.integers(1, 4))
+    snap_dir = tmp_path / "chaos"
+    plan = faults.FaultPlan(seed=1234)
+    plan.crash_at("workflow.step", when=lambda workflow, unit:
+                  int(workflow.decision.epoch_number) == crash_epoch)
+    with faults.active(plan):
+        report = run_supervised(
+            lambda: build(4, snap_dir, depth=2), str(snap_dir),
+            fast_policy())
+    assert plan.log, "the armed crash never fired"
+    assert report.restarts == 1
+    assert report.resumed_from, "supervisor did not resume from a snapshot"
+    assert report.workflow.decision.metrics_history == sync_hist
+    report.workflow.stop()
+
+
+def test_worker_fault_kill_and_resume(tmp_path, direct_transfers):
+    """A FaultPlan crash INSIDE the prefetch worker (site pipeline.fetch)
+    re-raises on the consumer; the supervisor restarts, restores, and the
+    resumed history is bit-exact vs the synchronous run."""
+    sync_hist, _ = run_history(4)
+
+    snap_dir = tmp_path / "chaos"
+    plan = faults.FaultPlan(seed=99)
+    plan.crash_at("pipeline.fetch", at_hit=14)   # mid-epoch-2 on the worker
+    with faults.active(plan):
+        report = run_supervised(
+            lambda: build(4, snap_dir, depth=2), str(snap_dir),
+            fast_policy())
+    assert plan.log == [{"site": "pipeline.fetch", "action": "crash",
+                         "hit": 14}]
+    assert report.restarts == 1 and report.resumed_from
+    assert report.workflow.decision.metrics_history == sync_hist
+    report.workflow.stop()
+    assert not _prefetch_threads(), "crashed run leaked a prefetch worker"
+
+
+# -- backpressure / shutdown -------------------------------------------------
+
+def _standalone_loader():
+    prng.seed_all(5)
+    loader = SyntheticClassifierLoader(
+        None, n_classes=4, sample_shape=(8,), n_train=400, n_valid=0,
+        minibatch_size=20)
+    loader.initialize(device=NumpyDevice())
+    return loader
+
+
+def test_backpressure_bounds_queue():
+    """The producer never runs more than ``depth`` batches ahead of the
+    consumer: a slow consumer fills the bounded queue and the worker
+    blocks (producer-starved accounting), it does not keep serving."""
+    loader = _standalone_loader()
+    pf = attach_prefetcher(loader, depth=2)
+    try:
+        pf.next_batch()                 # starts the worker
+        deadline = time.monotonic() + 5.0
+        while pf._queue.qsize() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)                 # give an unbounded producer rope
+        assert pf._queue.qsize() == 2
+        assert pf.stats.max_fill <= 2
+        # queue(2) + one batch built and blocked on put + one consumed
+        assert pf.stats.produced <= 2 + 1
+        # draining hands the blocked batch straight through, in order
+        offsets = [pf.next_batch().record["offset"] for _ in range(4)]
+        assert offsets == [20, 40, 60, 80]
+        # the blocked put has now completed: its wait shows up as
+        # producer-starved stall time
+        assert pf.stats.producer_starved_s > 0.1
+    finally:
+        pf.stop()
+
+
+def test_clean_shutdown_on_stop(direct_transfers):
+    """Workflow.stop() joins the worker thread (named so leak checks can
+    find it); next_batch afterwards raises PrefetcherStopped."""
+    w = build(2, depth=2)
+    w.run()
+    assert _prefetch_threads(), "worker should be parked at the barrier"
+    w.stop()
+    assert not _prefetch_threads(), "stop() leaked the prefetch worker"
+    with pytest.raises(PrefetcherStopped):
+        w.input_pipeline.next_batch()
+
+
+def test_double_attach_refused():
+    loader = _standalone_loader()
+    attach_prefetcher(loader, depth=1)
+    try:
+        with pytest.raises(ValueError, match="already has a pipeline"):
+            attach_prefetcher(loader, depth=1)
+    finally:
+        loader.pipeline.stop()
+
+
+# -- hot-path hygiene / observability ----------------------------------------
+
+def test_no_steady_state_recompiles(direct_transfers):
+    """ISSUE 4 acceptance: staged feeding adds zero recompiles — the
+    train/eval programs compile exactly once across a multi-epoch
+    pipelined run (staged arrays arrive with the step's own shardings)."""
+    w = build(3, depth=2)
+    w.run()
+    for fn in (w.step._train_fn, w.step._eval_fn):
+        if hasattr(fn, "_cache_size"):
+            assert fn._cache_size() == 1
+    w.stop()
+
+
+def test_timing_table_and_web_status(direct_transfers):
+    """Stall accounting surfaces in Workflow.timing_table() and in
+    WebStatus.register_pipeline's /status.json block."""
+    w = build(2, depth=2)
+    w.run()
+    table = w.timing_table()
+    for col in ("prod_stall", "cons_stall", "stage_s", "bound"):
+        assert col in table, table
+    status = WebStatus().register(w).register_pipeline(
+        "train_input", w.input_pipeline)
+    doc = status.snapshot()
+    block = doc["pipeline"]["train_input"]
+    assert block["depth"] == 2 and block["consumed"] == 2 * 9
+    assert block["bound"] in ("producer-starved", "consumer-starved",
+                              "transfer-bound", "balanced")
+    w.stop()
+
+
+def test_fill_batch_ring_reuses_buffers():
+    """With a slot-detaching stager the pipelined fill path rotates
+    depth+2 preallocated buffers instead of allocating per serve (the
+    non-pipelined fill_minibatch keeps its defensive fresh-buffer
+    copy).  Ring rotation is gated on the stager: without one the raw
+    host buffers reach async dispatch, so fills stay fresh-per-serve."""
+    loader = _standalone_loader()
+    # trivial detaching stager: nothing staged, but the contract (slots
+    # never escape to async dispatch) holds — rotation is enabled
+    pf = attach_prefetcher(loader, stager=lambda rec, arrays: (None, 0),
+                           depth=1)
+    try:
+        seen = []
+        for _ in range(7):
+            batch = pf.next_batch()
+            seen.append(id(batch.arrays["data"]))
+        assert len(set(seen)) == 3          # depth + 2 rotating slots
+        # and values are exactly what the sync gather would produce
+        batch = pf.next_batch()
+        idx = batch.record["indices"][:batch.record["size"]]
+        np.testing.assert_array_equal(
+            batch.arrays["data"][:len(idx)],
+            loader.original_data.mem[idx])
+    finally:
+        pf.stop()
+
+
+def test_fill_batch_fresh_buffers_without_stager():
+    """A stager-less pipeline must NOT rotate ring slots: the host
+    buffers it hands over can be aliased by async dispatch (the hazard
+    fill_minibatch's defensive copy exists for), so every serve gets a
+    fresh buffer."""
+    loader = _standalone_loader()
+    pf = attach_prefetcher(loader, depth=1)
+    try:
+        # hold the arrays so a freed buffer's id cannot be recycled
+        held = [pf.next_batch().arrays["data"] for _ in range(5)]
+        assert len({id(a) for a in held}) == 5
+    finally:
+        pf.stop()

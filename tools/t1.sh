@@ -4,4 +4,10 @@
 # Exit code is pytest's; DOTS_PASSED echoes the passed-test count the
 # driver greps for.
 cd "$(dirname "$0")/.." || exit 1
+if ! python -c "import pytest" 2>/dev/null; then
+    echo "tools/t1.sh: pytest is not importable in this Python" \
+         "($(command -v python || echo 'python not found')) — install it" \
+         "or activate the right environment" >&2
+    exit 2
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -42,6 +42,10 @@ class Workflow(Unit):
         #: per signal delivery); the resilience supervisor's watchdog
         #: polls it to detect a hung step
         self.signals_dispatched = 0
+        #: input prefetchers registered by znicz_tpu.pipeline
+        #: .attach_prefetcher — stopped on crash, surfaced in
+        #: timing_table's stall block
+        self.pipelines: list = []
 
     # -- child management ---------------------------------------------------
     def add_unit(self, unit: Unit) -> None:
@@ -113,16 +117,24 @@ class Workflow(Unit):
                 unit.links_from[provider] = False
         queue: deque[tuple[Unit, Unit]] = deque()
         self.start_point._signal(None, queue)
-        while queue:
-            source, target = queue.popleft()
-            # chaos hook: the resilience plane injects crashes/hangs here
-            # (site "workflow.step") so fault tests drive this real loop;
-            # with no plan installed this is a single global None check
-            fault_hook("workflow.step", workflow=self, unit=target)
-            self.signals_dispatched += 1
-            target._signal(source, queue)
-            if self.end_point.reached:
-                break
+        try:
+            while queue:
+                source, target = queue.popleft()
+                # chaos hook: the resilience plane injects crashes/hangs
+                # here (site "workflow.step") so fault tests drive this
+                # real loop; with no plan installed this is a single
+                # global None check
+                fault_hook("workflow.step", workflow=self, unit=target)
+                self.signals_dispatched += 1
+                target._signal(source, queue)
+                if self.end_point.reached:
+                    break
+        except BaseException:
+            # a crashed walk must not leak prefetch workers: the
+            # supervisor rebuilds fresh objects, so stop ours now
+            for pipeline in self.pipelines:
+                pipeline.stop()
+            raise
         self._wall_time += time.monotonic() - started
         self.run_was_called = True
 
@@ -133,7 +145,11 @@ class Workflow(Unit):
 
     # -- statistics ---------------------------------------------------------
     def timing_table(self) -> str:
-        """Per-unit wall-time share table (reference: printed at stop)."""
+        """Per-unit wall-time share table (reference: printed at stop),
+        followed by the input-pipeline stall breakdown when prefetchers
+        are attached (docs/PIPELINE.md: ``prod_stall`` = producer waited
+        for a free slot, ``cons_stall`` = consumer waited on an empty
+        queue, ``stage_s`` = H2D staging time on the worker)."""
         rows = sorted(((u._run_time, u._run_count, u.name) for u in self.units),
                       reverse=True)
         total = sum(r[0] for r in rows) or 1e-12
@@ -143,4 +159,19 @@ class Workflow(Unit):
                 continue
             lines.append(
                 f"{name:<28}{count:>8}{run_time:>10.3f}{run_time / total:>8.1%}")
+        if self.pipelines:
+            lines.append("")
+            lines.append(
+                f"{'pipeline':<10}{'depth':>6}{'batches':>9}{'MB':>9}"
+                f"{'serve_s':>9}{'stage_s':>9}{'prod_stall':>11}"
+                f"{'cons_stall':>11}  bound")
+            for i, pipeline in enumerate(self.pipelines):
+                s = pipeline.stats.snapshot()
+                lines.append(
+                    f"{'prefetch' + str(i):<10}{s['depth']:>6}"
+                    f"{s['consumed']:>9}"
+                    f"{s['bytes_staged'] / 1e6:>9.2f}"
+                    f"{s['serve_s']:>9.3f}{s['stage_s']:>9.3f}"
+                    f"{s['producer_starved_s']:>11.3f}"
+                    f"{s['consumer_starved_s']:>11.3f}  {s['bound']}")
         return "\n".join(lines)

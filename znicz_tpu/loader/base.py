@@ -89,11 +89,22 @@ class Loader(AcceleratedUnit):
         #: plan at each class start (dead work for everyone else)
         self.capture_class_plan = False
         self._current_plan = None        # captured at each class start
+        #: attached BatchPrefetcher (znicz_tpu.pipeline) — when set, run()
+        #: consumes prefetched batches instead of serving synchronously
+        self.pipeline = None
+        #: device arrays pre-staged by the pipeline for the CURRENT batch
+        #: (consumed one-shot by the step via take_staged)
+        self.staged = None
         # dataset geometry, set by load_data()
         self.class_lengths = [0, 0, 0]
         self._position = 0               # offset within current class
         self._class = TEST
+        self._epoch = 0                  # private epoch cursor: epoch_number
+        #                                  is its published mirror (the
+        #                                  pipeline producer advances this;
+        #                                  only the consumer writes publics)
         self._shuffled: dict[int, np.ndarray] = {}
+        self._rings: dict[str, dict] = {}   # fill_batch rotating buffers
 
     # -- override points ----------------------------------------------------
     def load_data(self) -> None:
@@ -108,6 +119,41 @@ class Loader(AcceleratedUnit):
         """Copy rows selected by ``minibatch_indices`` into the served
         arrays; indices beyond ``minibatch_size`` are -1 (padding)."""
         raise NotImplementedError
+
+    def fill_batch(self, indices: np.ndarray, count: int) -> dict:
+        """Pipeline-producer fill: gather the rows selected by ``indices``
+        (-1 = padding, zeroed) into PRODUCER-OWNED buffers and return them
+        as ``{"data": ..., "labels": ..., "targets": ...}`` (present keys
+        only).  Unlike :meth:`fill_minibatch` this must not touch the
+        published ``minibatch_*`` attributes — it runs on the prefetch
+        worker while downstream units still read the previous batch.
+        Implementations use :meth:`_next_buffer` so the staging ring owns
+        buffer lifetimes (no per-step defensive copy)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement fill_batch — the "
+            f"prefetch pipeline needs a producer-side fill that leaves "
+            f"the published minibatch_* attributes alone")
+
+    def _next_buffer(self, key: str, shape: tuple, dtype) -> np.ndarray:
+        """Rotating preallocated buffer for ``fill_batch``: the ring holds
+        ``pipeline.depth + 2`` slots (queue depth + the batch in flight +
+        the one being consumed), so a buffer is only reused after its
+        batch has fully left the pipeline — this is what lets the
+        pipelined path drop fill_minibatch's fresh-buffer-per-serve copy.
+        Rotation requires a slot-detaching stager (ring_safe_stager's
+        copy/fence); a stager-less pipeline hands raw host buffers to
+        async dispatch, so it gets a fresh buffer per serve instead."""
+        if self.pipeline is None or not self.pipeline.detaches_slots:
+            return np.empty(shape, dtype)
+        slots = self.pipeline.depth + 2
+        ring = self._rings.setdefault(key, {"bufs": [], "i": 0})
+        bufs = ring["bufs"]
+        if len(bufs) < slots:
+            bufs.append(np.empty(shape, dtype))
+            return bufs[-1]
+        buf = bufs[ring["i"] % slots]
+        ring["i"] += 1
+        return buf
 
     # -- geometry helpers ---------------------------------------------------
     @property
@@ -149,54 +195,128 @@ class Loader(AcceleratedUnit):
                     self.class_offset(cls) + self.class_lengths[cls],
                     dtype=np.int64)
         if self.shuffle_limit is not None and \
-                self.epoch_number >= self.shuffle_limit:
+                self._epoch >= self.shuffle_limit:
             return
         prng.get().shuffle(self._shuffled[TRAIN])
 
     # -- serving ------------------------------------------------------------
     def numpy_run(self) -> None:
+        if self.pipeline is not None:
+            self._consume_prefetched()
+            return
         self._serve()
 
     def xla_run(self) -> None:
+        if self.pipeline is not None:
+            self._consume_prefetched()
+            if self.staged is None and not self.serve_indices_only:
+                # no stager attached: upload on the consumer thread
+                # exactly like the synchronous path below
+                self._upload_minibatch()
+            return
         self._serve()
         if self.serve_indices_only:
             # the fused step pinned the dataset on HBM: it consumes only
             # minibatch_indices, so the host gather + device upload of the
             # minibatch itself would be pure dead work on the hot loop
             return
+        self._upload_minibatch()
+
+    def _upload_minibatch(self) -> None:
         # upload the freshly filled host rows
         for arr in (self.minibatch_data, self.minibatch_labels,
                     self.minibatch_targets):
             if arr:
                 arr.unmap()
 
-    def _serve(self) -> None:
-        self.epoch_ended = False
+    def _next_record(self) -> dict:
+        """Advance the PRIVATE serving cursor one minibatch and return the
+        control record — publishes nothing.  The sync path and the
+        pipeline producer share this core, so serve order (and therefore
+        prng order) is identical with prefetching on or off."""
         cls = self._class
         length = self.class_lengths[cls]
         start = self._position
         count = min(self.max_minibatch_size, length - start)
         indices = np.full((self.max_minibatch_size,), -1, dtype=np.int64)
         indices[:count] = self._shuffled[cls][start:start + count]
-        self.minibatch_indices.map_invalidate()
-        self.minibatch_indices.mem = indices
-        self.minibatch_size = count
-        self.minibatch_class = cls
-        self.minibatch_offset = start
         self._position = start + count
-        self.last_minibatch = self._position >= length
+        rec = {"indices": indices, "size": count, "cls": cls,
+               "offset": start, "last": self._position >= length,
+               "plan": None, "epoch_ended": False,
+               "epoch_number": self._epoch}
         if start == 0 and self.capture_class_plan:
-            self._current_plan = self._capture_class_plan(cls)
+            rec["plan"] = self._capture_class_plan(cls)
+        return rec
+
+    def _complete_record(self, rec: dict) -> dict:
+        """Class/epoch advance for a record from :meth:`_next_record` —
+        runs AFTER the fill (reference order: augmenting fills draw prng
+        before the epoch-boundary reshuffle)."""
+        if rec["last"]:
+            classes = self._nonempty_classes()
+            idx = classes.index(self._class)
+            if idx + 1 < len(classes):
+                self._class = classes[idx + 1]
+            else:
+                # train pass done -> epoch boundary
+                self._epoch += 1
+                rec["epoch_ended"] = True
+                self._class = classes[0]
+                self._shuffle_train()
+            self._position = 0
+        rec["epoch_number"] = self._epoch
+        return rec
+
+    def _publish_record(self, rec: dict) -> None:
+        """Write a record's control metadata into the published attrs the
+        downstream units read (consumer-thread only)."""
+        self.epoch_ended = False
+        self.minibatch_indices.map_invalidate()
+        self.minibatch_indices.mem = rec["indices"]
+        self.minibatch_size = rec["size"]
+        self.minibatch_class = rec["cls"]
+        self.minibatch_offset = rec["offset"]
+        self.last_minibatch = rec["last"]
+        if rec["plan"] is not None:
+            self._current_plan = rec["plan"]
+
+    def _serve(self) -> None:
+        rec = self._next_record()
+        self._publish_record(rec)
         if not self.serve_indices_only:
             self.fill_minibatch()
-        if self.last_minibatch:
-            self._advance_class()
+        self._complete_record(rec)
+        self.epoch_number = rec["epoch_number"]
+        self.epoch_ended = rec["epoch_ended"]
+
+    def _consume_prefetched(self) -> None:
+        """Pop the next pipelined batch and replay it: control metadata,
+        filled host arrays, and the pre-staged device payload."""
+        batch = self.pipeline.next_batch()
+        rec = batch.record
+        self._publish_record(rec)
+        if batch.arrays:
+            for name, host in batch.arrays.items():
+                arr = getattr(self, f"minibatch_{name}")
+                arr.map_invalidate()
+                arr.mem = host
+        self.staged = batch.staged
+        self.epoch_number = rec["epoch_number"]
+        self.epoch_ended = rec["epoch_ended"]
+
+    def take_staged(self):
+        """One-shot handoff of the pipeline's device-staged payload for
+        the current batch (None in sync mode or when nothing was
+        staged) — steps call this instead of re-uploading the batch."""
+        staged, self.staged = self.staged, None
+        return staged
 
     def class_plan(self) -> np.ndarray:
         """The FULL minibatch plan of the class currently being served:
         ``(n_minibatches, max_minibatch_size)`` int64 indices, -1 padding
         on the final partial row.  Captured at the first serve of the
-        class pass — for a single-minibatch class, ``_advance_class``
+        class pass — for a single-minibatch class, ``_complete_record``
         (and the epoch-boundary reshuffle) has ALREADY run by the time
         the consumer acts, so reading ``_shuffled`` lazily would hand out
         the next class's plan.  Consumers (FusedTrainStep epoch scanning)
@@ -214,30 +334,30 @@ class Loader(AcceleratedUnit):
         flat[:length] = order[:length]
         return plan
 
-    def _advance_class(self) -> None:
-        classes = self._nonempty_classes()
-        idx = classes.index(self._class)
-        if idx + 1 < len(classes):
-            self._class = classes[idx + 1]
-        else:
-            # train pass done -> epoch boundary
-            self.epoch_number += 1
-            self.epoch_ended = True
-            self._class = classes[0]
-            self._shuffle_train()
-        self._position = 0
+    # -- lifecycle ----------------------------------------------------------
+    def stop(self) -> None:
+        if self.pipeline is not None:
+            self.pipeline.stop()
 
     # -- snapshot support ---------------------------------------------------
     def state_dict(self) -> dict:
+        # at a snapshot point (epoch boundary) the pipeline's determinism
+        # barrier guarantees the private cursor equals the sync-mode state
         return {
-            "epoch_number": int(self.epoch_number),
+            "epoch_number": int(self._epoch),
             "position": int(self._position),
             "cls": int(self._class),
             "shuffled": {c: v.copy() for c, v in self._shuffled.items()},
         }
 
     def load_state_dict(self, state: dict) -> None:
-        self.epoch_number = state["epoch_number"]
+        if self.pipeline is not None:
+            # prefetched batches belong to the pre-restore cursor: drain
+            # the worker and re-arm it on the restored state
+            self.pipeline.resync()
+        self.staged = None
+        self._epoch = int(state["epoch_number"])
+        self.epoch_number = self._epoch
         self._position = state["position"]
         self._class = state["cls"]
         self._shuffled = {c: np.asarray(v) for c, v in

@@ -51,7 +51,9 @@ class FullBatchLoader(Loader):
         # FRESH buffer every serve: with deferred metrics the step's jit
         # dispatch is asynchronous, so the previously served buffer may
         # still be being read — in-place refill would race with it (the
-        # old buffer stays alive via the pending computation instead)
+        # old buffer stays alive via the pending computation instead).
+        # The pipelined path (fill_batch) drops this defensive copy: the
+        # staging ring owns buffer lifetimes there.
         data = np.empty((self.max_minibatch_size,) + src.shape[1:],
                         src.dtype)
         # native threaded gather when available (bit-identical result;
@@ -70,6 +72,31 @@ class FullBatchLoader(Loader):
             labels = np.zeros((self.max_minibatch_size,), np.int32)
             labels[:count] = self.original_labels.mem[idx]
             self.minibatch_labels.mem = labels
+
+    def fill_batch(self, indices: np.ndarray, count: int) -> dict:
+        """Producer-side gather for the prefetch pipeline.  Unlike
+        :meth:`fill_minibatch` there is NO per-serve defensive copy: the
+        staging ring owns buffer lifetimes (a slot is reused only after
+        its batch has left the pipeline), so the gather lands in a
+        rotating preallocated buffer instead of a fresh allocation."""
+        src = self.original_data.mem
+        data = self._next_buffer(
+            "data", (self.max_minibatch_size,) + src.shape[1:], src.dtype)
+        from znicz_tpu import native
+        if native.available() and src.flags.c_contiguous and \
+                src.dtype == data.dtype:
+            native.gather_rows(src, np.ascontiguousarray(indices), data)
+        else:
+            data[:count] = src[indices[:count]]
+            data[count:] = 0
+        out = {"data": data}
+        if self.original_labels:
+            labels = self._next_buffer(
+                "labels", (self.max_minibatch_size,), np.int32)
+            labels[:count] = self.original_labels.mem[indices[:count]]
+            labels[count:] = 0
+            out["labels"] = labels
+        return out
 
 
 class FullBatchLoaderMSE(FullBatchLoader):
@@ -96,3 +123,14 @@ class FullBatchLoaderMSE(FullBatchLoader):
                            src.dtype)
         targets[:count] = src[indices[:count]]
         self.minibatch_targets.mem = targets
+
+    def fill_batch(self, indices: np.ndarray, count: int) -> dict:
+        out = super().fill_batch(indices, count)
+        src = self.original_targets.mem
+        targets = self._next_buffer(
+            "targets", (self.max_minibatch_size,) + src.shape[1:],
+            src.dtype)
+        targets[:count] = src[indices[:count]]
+        targets[count:] = 0
+        out["targets"] = targets
+        return out

@@ -99,12 +99,12 @@ class CharSequenceLoader(Loader):
         self.minibatch_data.reset(shape=shape, dtype=np.int32)
         self.minibatch_labels.reset(shape=shape, dtype=np.int32)
 
-    def fill_minibatch(self) -> None:
-        idx = self.minibatch_indices.mem
-        data = self.minibatch_data.map_write()
-        labels = self.minibatch_labels.map_write()
+    def _fill_rows(self, data, labels, indices) -> None:
+        """THE window gather (sync and pipelined fills share it, so the
+        two paths cannot drift): tokens/next-char labels per index row,
+        zeroed padding for -1."""
         T = self.seq_len
-        for row, gi in enumerate(idx):
+        for row, gi in enumerate(indices):
             if gi < 0:
                 data[row] = 0
                 labels[row] = 0
@@ -113,6 +113,20 @@ class CharSequenceLoader(Loader):
             off = int(self._starts[gi])
             data[row] = stream[off:off + T]
             labels[row] = stream[off + 1:off + T + 1]
+
+    def fill_minibatch(self) -> None:
+        self._fill_rows(self.minibatch_data.map_write(),
+                        self.minibatch_labels.map_write(),
+                        self.minibatch_indices.mem)
+
+    def fill_batch(self, indices: np.ndarray, count: int) -> dict:
+        """Producer-side fill for the prefetch pipeline (ring-owned
+        buffers, published attrs untouched)."""
+        shape = (self.max_minibatch_size, self.seq_len)
+        data = self._next_buffer("data", shape, np.int32)
+        labels = self._next_buffer("labels", shape, np.int32)
+        self._fill_rows(data, labels, indices)
+        return {"data": data, "labels": labels}
 
     # -- snapshot support ---------------------------------------------------
     def state_dict(self) -> dict:

@@ -26,7 +26,8 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
           n_experts: int | None = None,
           moe_aux_weight: float = 0.0,
           moe_top_k: int = 1,
-          moe_zloss_weight: float = 0.0) -> NNWorkflow:
+          moe_zloss_weight: float = 0.0,
+          pipeline_depth: int | None = None) -> NNWorkflow:
     w = NNWorkflow(name="CharLM")
     w.repeater = Repeater(w)
     w.loader = CharSequenceLoader(
@@ -63,6 +64,12 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
     dec.link_attrs(w.loader, "minibatch_class", "last_minibatch",
                    "class_lengths", "epoch_number")
     dec.link_attrs(step, "minibatch_mse", "minibatch_size")
+    if pipeline_depth:
+        # async input pipeline: the corpus windowing + the fused
+        # tokens/labels/mask put overlap the previous step's compute
+        from znicz_tpu.pipeline import attach_prefetcher
+        attach_prefetcher(w.loader, stager=step.make_stager(),
+                          depth=pipeline_depth)
     return w
 
 

@@ -105,7 +105,8 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
                 minibatch_size=64, n_train=640, n_valid=192,
                 mesh=None, loader=None, optimizer="sgd",
                 optimizer_config=None, shard_update=False,
-                accumulate_steps=1, ema_decay=None) -> NNWorkflow:
+                accumulate_steps=1, ema_decay=None,
+                pipeline_depth=None) -> NNWorkflow:
     """TPU-native shape: Repeater -> Loader -> FusedTrainStep -> Decision."""
     w = NNWorkflow(name="MnistFC-fused")
     w.repeater = Repeater(w)
@@ -149,4 +150,10 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
     # sample count behind the (possibly class-pass-aggregated) metrics
     # comes from the step, not the loader — see standard_workflow.py
     dec.link_attrs(step, ("minibatch_n_err", "n_err"), "minibatch_size")
+    if pipeline_depth:
+        # async input pipeline: host gather + H2D staging of batch k+1
+        # overlap the compute of batch k (znicz_tpu.pipeline)
+        from znicz_tpu.pipeline import attach_prefetcher
+        attach_prefetcher(w.loader, stager=step.make_stager(),
+                          depth=pipeline_depth)
     return w

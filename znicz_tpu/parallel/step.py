@@ -217,25 +217,34 @@ class FusedTrainStep(Unit):
     #: leaf keys holding optimizer state (sharded under shard_update)
     OPT_STATE_KEYS = ("vw", "vb", "sw", "sb")
 
+    def _put(self, value, spec=P()):
+        """THE device placement convention: ``value`` (array or pytree)
+        onto this step's mesh under ``spec`` (a PartitionSpec or a
+        matching pytree of them).  The input pipeline's stager
+        (:meth:`make_stager`) shares this, so a pre-staged batch lands
+        with exactly the layout the compiled step expects."""
+        from jax.sharding import NamedSharding
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), spec,
+            is_leaf=lambda s: isinstance(s, P))
+        return jax.device_put(value, shardings)
+
     def _flat_shard_put(self, host_arr):
         """Flatten + pad an optimizer-state array and place it sharded
         over the ``data`` axis (ZeRO layout).  Dtype-preserving: callers
         own the storage dtype (f32 snapshots/adam moments; state_dtype
         momenta arrive pre-narrowed from put_state)."""
-        from jax.sharding import NamedSharding
         n = self.mesh.shape["data"]
         flat = np.asarray(host_arr).reshape(-1)
         flat = np.pad(flat, (0, (-len(flat)) % n))
-        return jax.device_put(flat, NamedSharding(self.mesh, P("data")))
+        return self._put(flat, P("data"))
 
     def gather_params(self):
         """Build the params pytree from the unit Arrays: w/b replicated
         over the mesh (the sharding the step outputs, so the jit
         signature is stable from the first call); optimizer-state leaves
         flat-sharded over ``data`` when ``shard_update``."""
-        from jax.sharding import NamedSharding
-        rep = NamedSharding(self.mesh, P())
-        put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
+        put = lambda a: self._put(np.asarray(a))  # noqa: E731
         put_v = self._flat_shard_put if self.shard_update else put
 
         def put_state(a):
@@ -324,10 +333,7 @@ class FusedTrainStep(Unit):
         host = self.hyper_params()
         sig = tuple(tuple(sorted(h.items())) for h in host)
         if self._hyper_cache is None or self._hyper_cache[0] != sig:
-            from jax.sharding import NamedSharding
-            rep = NamedSharding(self.mesh, P())
-            dev = jax.device_put(
-                jax.tree.map(np.float32, host), rep)
+            dev = self._put(jax.tree.map(np.float32, host))
             self._hyper_cache = (sig, dev)
         return self._hyper_cache[1]
 
@@ -363,15 +369,12 @@ class FusedTrainStep(Unit):
     def load_extra_state(self, arrays: dict) -> None:
         """Restore extra_state_arrays output into the (already rebuilt)
         device params — call after gather_params on resume."""
-        from jax.sharding import NamedSharding
-        rep = NamedSharding(self.mesh, P())
         for key, val in arrays.items():
             i, k = key.split(".", 1)
             if k not in ("t", "ew", "eb") and self.shard_update:
                 self._params[int(i)][k] = self._flat_shard_put(val)
             else:
-                self._params[int(i)][k] = jax.device_put(
-                    np.asarray(val), rep)
+                self._params[int(i)][k] = self._put(np.asarray(val))
 
     def sync_to_units(self) -> None:
         """Write the device params back into the unit Arrays (snapshot /
@@ -732,9 +735,7 @@ class FusedTrainStep(Unit):
             self.compute_dtype = getattr(device, "compute_dtype", None) or \
                 jnp.float32
         self._params = self.gather_params()
-        from jax.sharding import NamedSharding
-        self._key = jax.device_put(prng.get().key(),
-                                   NamedSharding(self.mesh, P()))
+        self._key = self._put(prng.get().key())
         rep, sh = P(), P("data")
         pspecs = self.param_specs()
         train = shard_map(self._local_train, mesh=self.mesh,
@@ -797,11 +798,9 @@ class FusedTrainStep(Unit):
         data = np.asarray(data_arr.mem, np.float32)
         if data.nbytes > limit:
             return
-        from jax.sharding import NamedSharding
-        rep_sh = NamedSharding(self.mesh, P())
         self._dataset_dev = (
-            jax.device_put(data, rep_sh),
-            jax.device_put(np.asarray(labels_arr.mem), rep_sh))
+            self._put(data),
+            self._put(np.asarray(labels_arr.mem)))
         rep, sh = P(), P("data")
         pspecs = self.param_specs()
         train = shard_map(self._local_train_idx, mesh=self.mesh,
@@ -907,9 +906,58 @@ class FusedTrainStep(Unit):
             self._params, self._key, self._hyper_device(), xs, ys, masks)
         return metrics
 
+    # -- input-pipeline staging ---------------------------------------------
+    def make_stager(self):
+        """Producer-side staging callable for the input pipeline
+        (znicz_tpu.pipeline): issues the NEXT batch's ``device_put`` with
+        this step's input shardings while the current step is still
+        executing, so the H2D transfer hides under device compute.
+        Signature: ``stage(record, arrays) -> (staged_dict, nbytes)``.
+
+        Ring-slot safety: ``arrays`` come from the loader's rotating
+        fill_batch buffers, handed off through
+        :func:`~znicz_tpu.pipeline.prefetcher.ring_safe_stager` (copy on
+        the aliasing CPU backend, H2D fence on accelerators)."""
+        from znicz_tpu.pipeline.prefetcher import ring_safe_stager
+
+        sh = P("data")
+        # ONE tuple put: batch, labels/targets and mask ride a single
+        # staging call
+        safe_put = ring_safe_stager(
+            lambda x, y, m: self._put((x, y, m), (sh, sh, sh)))
+
+        def stage(rec, arrays):
+            if self._scan_idx_fns:
+                # epoch-scan feeding dispatches whole class passes from
+                # the captured plan — per-minibatch staging would be
+                # dead device buffers (the pipeline still overlaps the
+                # shuffle/plan work)
+                return None, 0
+            mask = rec["indices"] >= 0
+            if self._dataset_dev is not None:
+                # index-fed mode: only the indices + mask ride H2D (both
+                # freshly built per record — no ring slot to protect)
+                idx = np.maximum(rec["indices"], 0).astype(np.int32)
+                idx_d, mask_d = self._put((idx, mask), (sh, sh))
+                return ({"idx": idx_d, "mask": mask_d},
+                        idx.nbytes + mask.nbytes)
+            x = arrays["data"]
+            y = arrays["targets" if isinstance(self.evaluator, EvaluatorMSE)
+                       else "labels"]
+            x_d, y_d, mask_d = safe_put(x, y, mask)
+            return ({"x": x_d, "y": y_d, "mask": mask_d},
+                    x.nbytes + y.nbytes + mask.nbytes)
+
+        return stage
+
     # -- per-minibatch control callback -------------------------------------
     def run(self) -> None:
         loader = self.loader
+        # pipelined feeding: the batch (or its indices) was device_put by
+        # the prefetch worker with this step's shardings — consume the
+        # staged arrays instead of re-shipping the host copies
+        staged = loader.take_staged() \
+            if getattr(loader, "pipeline", None) is not None else None
         if self._dataset_dev is not None and self._scan_idx_fns and \
                 (int(loader.minibatch_offset) == 0 or
                  self._scan_in_flight):
@@ -918,12 +966,14 @@ class FusedTrainStep(Unit):
         # (a class pass entered MID-WAY — restored loader state — falls
         # through to the per-minibatch path for the remainder; _acc is
         # NOT a valid in-flight marker because that path sets it too)
-        mask = loader.minibatch_indices.mem >= 0
+        mask = staged["mask"] if staged is not None else \
+            loader.minibatch_indices.mem >= 0
         accumulate = self.accumulate_steps > 1
         if self._dataset_dev is not None:
             # index-fed hot path: dataset already on HBM
-            idx = np.maximum(loader.minibatch_indices.mem, 0).astype(
-                np.int32)
+            idx = staged["idx"] if staged is not None else \
+                np.maximum(loader.minibatch_indices.mem, 0).astype(
+                    np.int32)
             data, labels_all = self._dataset_dev
             if int(loader.minibatch_class) != TRAIN:
                 metrics = self._eval_fn_idx(self._params, data, labels_all,
@@ -938,10 +988,13 @@ class FusedTrainStep(Unit):
                     data, labels_all, idx, mask)
             self._finish_run(loader, metrics)
             return
-        x = loader.minibatch_data.mem
-        if isinstance(self.evaluator, EvaluatorMSE):
+        if staged is not None:
+            x, labels = staged["x"], staged["y"]
+        elif isinstance(self.evaluator, EvaluatorMSE):
+            x = loader.minibatch_data.mem
             labels = loader.minibatch_targets.mem
         else:
+            x = loader.minibatch_data.mem
             labels = loader.minibatch_labels.mem
         if int(loader.minibatch_class) != TRAIN:
             metrics = self._eval_fn(self._params, x, labels, mask)

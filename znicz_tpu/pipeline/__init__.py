@@ -1,0 +1,11 @@
+"""Async input pipeline: prefetching loaders with overlapped host→device
+staging (docs/PIPELINE.md).  Not to be confused with
+``znicz_tpu.parallel.pipeline`` (GPipe-style model pipeline parallelism)."""
+
+from znicz_tpu.pipeline.prefetcher import (BatchPrefetcher, PipelineStats,
+                                           PrefetcherStopped, StagedBatch,
+                                           attach_prefetcher,
+                                           ring_safe_stager)
+
+__all__ = ["BatchPrefetcher", "PipelineStats", "PrefetcherStopped",
+           "StagedBatch", "attach_prefetcher", "ring_safe_stager"]

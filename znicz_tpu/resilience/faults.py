@@ -11,6 +11,10 @@ site                 hook location
 ``snapshot.write``   ``snapshotter.write_snapshot``, before the atomic
                      publish (context: ``path``)
 ``serve.run``        ``serve/engine.py`` ``BatchEngine.run`` entry
+``pipeline.fetch``   ``pipeline/prefetcher.py`` worker loop, once per
+                     prefetched batch (context: ``loader``, ``batch``);
+                     a crash here re-raises on the consumer — the
+                     supervisor sees an ordinary failed step
 ``step.loss``        ``parallel/step.py`` metric publish — value-poison
                      site (NaN into the published loss)
 ``step.params``      ``parallel/step.py`` after a train dispatch —

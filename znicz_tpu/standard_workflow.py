@@ -125,6 +125,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  snapshotter_config: Optional[dict] = None,
                  health_config: Optional[dict] = None,
                  fused: bool = True, mesh=None,
+                 pipeline_config: Optional[dict] = None,
                  defer_metrics: bool = True,
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
@@ -148,6 +149,10 @@ class StandardWorkflow(StandardWorkflowBase):
         self.health_config = health_config
         self.fused = fused
         self.mesh = mesh
+        #: async input pipeline (znicz_tpu.pipeline): ``{"depth": N}``
+        #: prefetches N batches ahead with overlapped H2D staging; None =
+        #: synchronous serving (docs/PIPELINE.md)
+        self.pipeline_config = pipeline_config
         self.defer_metrics = defer_metrics
         #: "sgd" (reference parity, eager + fused) or "adam" (AdamW,
         #: fused-only extension — the eager gd units carry SGD semantics)
@@ -180,7 +185,13 @@ class StandardWorkflow(StandardWorkflowBase):
             raise ValueError(f"clip_norm must be positive, got {clip_norm}"
                              f" (0 freezes training; negative flips the "
                              f"gradient sign)")
+        if pipeline_config is not None and not fused:
+            raise ValueError(
+                "pipeline_config requires fused=True (the eager per-unit "
+                "path owns its own host uploads and may draw host prng "
+                "per step, which the prefetch producer would reorder)")
         self.snapshotter = None
+        self.input_pipeline = None
         self.health_guard = None
         self.nn_rollback = None
         self.create_workflow()
@@ -194,6 +205,8 @@ class StandardWorkflow(StandardWorkflowBase):
         self.link_decision(self.evaluator)
         if self.fused:
             self.link_fused_step()
+            if self.pipeline_config is not None:
+                self.link_pipeline()
         else:
             self.link_gds()
         self.link_health()
@@ -317,6 +330,16 @@ class StandardWorkflow(StandardWorkflowBase):
         else:
             self.decision.link_attrs(step, ("minibatch_mse", "mse"))
         self._tail = self.decision
+
+    def link_pipeline(self) -> None:
+        """Async input pipeline: a prefetch worker runs the loader's
+        serve loop ahead of the step and stages each batch onto the
+        step's mesh while the previous step computes
+        (znicz_tpu.pipeline, docs/PIPELINE.md)."""
+        from znicz_tpu.pipeline import attach_prefetcher
+        self.input_pipeline = attach_prefetcher(
+            self.loader, stager=self.step.make_stager(),
+            **self.pipeline_config)
 
     def link_health(self) -> None:
         """Resilience plane: per-step NaN/Inf guard between the metric

@@ -119,6 +119,41 @@ class TransformerLMStep(AcceleratedUnit):
         #: minibatch placement: batch over data, time over seq
         self._batch_sharding = NamedSharding(self.mesh, P("data", "seq"))
         self._mask_sharding = NamedSharding(self.mesh, P("data"))
+        #: reused mask row — the hot loop allocates nothing per step
+        self._arange = np.arange(self.loader.max_minibatch_size)
+
+    def _stage_batch(self, tokens, labels, count: int):
+        """ONE fused ``device_put``: tokens, labels and the padding mask
+        ride a single staged tuple transfer instead of three separate
+        H2D trips (shared by xla_run and the input-pipeline stager)."""
+        import jax
+
+        return jax.device_put(
+            (tokens, labels, self._arange < count),
+            (self._batch_sharding, self._batch_sharding,
+             self._mask_sharding))
+
+    def make_stager(self):
+        """Producer-side staging for the input pipeline
+        (znicz_tpu.pipeline): the worker issues the next batch's fused
+        tuple put while the current step computes; ring-slot handoff via
+        the shared ring_safe_stager (copy on the aliasing CPU backend,
+        H2D fence on accelerators)."""
+        import jax
+
+        from znicz_tpu.pipeline.prefetcher import ring_safe_stager
+
+        safe_put = ring_safe_stager(lambda t, l, m: jax.device_put(
+            (t, l, m), (self._batch_sharding, self._batch_sharding,
+                        self._mask_sharding)))
+
+        def stage(rec, arrays):
+            tokens, labels = arrays["data"], arrays["labels"]
+            staged = safe_put(tokens, labels, self._arange < rec["size"])
+            nbytes = tokens.nbytes + labels.nbytes + \
+                self._arange.size  # one byte per bool mask element
+            return {"lm": staged}, nbytes
+        return stage
 
     def _place_params(self, params):
         """Mesh placement by param_specs — the ONE layout used by init
@@ -142,15 +177,18 @@ class TransformerLMStep(AcceleratedUnit):
     def xla_run(self) -> None:
         import jax
 
-        self.loader.minibatch_data.unmap()
-        self.loader.minibatch_labels.unmap()
-        tokens = jax.device_put(self.loader.minibatch_data.devmem,
-                                self._batch_sharding)
-        labels = jax.device_put(self.loader.minibatch_labels.devmem,
-                                self._batch_sharding)
-        count = int(self.loader.minibatch_size)
-        mask = jax.device_put(
-            np.arange(tokens.shape[0]) < count, self._mask_sharding)
+        loader = self.loader
+        count = int(loader.minibatch_size)
+        staged = loader.take_staged() \
+            if getattr(loader, "pipeline", None) is not None else None
+        if staged is not None:
+            # pipelined feeding: the prefetch worker already issued the
+            # fused tuple put, overlapped with the previous step
+            tokens, labels, mask = staged["lm"]
+        else:
+            tokens, labels, mask = self._stage_batch(
+                loader.minibatch_data.mem, loader.minibatch_labels.mem,
+                count)
         if int(self.loader.minibatch_class) == TRAIN:
             self._params, loss = self._step(self._params, tokens, labels,
                                             mask)

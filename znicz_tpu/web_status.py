@@ -27,6 +27,7 @@ class WebStatus(Logger):
         self.workflows: list = []
         self.serving: list = []
         self.health: list = []
+        self.pipelines: list = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = port
@@ -62,6 +63,21 @@ class WebStatus(Logger):
         self.health.append((str(name), fn))
         return self
 
+    def register_pipeline(self, name: str, pipeline) -> "WebStatus":
+        """Surface an input pipeline's stall accounting in
+        ``/status.json`` (next to the serving and health metrics):
+        ``pipeline`` is a
+        :class:`~znicz_tpu.pipeline.BatchPrefetcher` (its
+        ``stats_snapshot``), anything with a ``snapshot()``, or a
+        zero-arg callable returning a dict."""
+        fn = getattr(pipeline, "stats_snapshot", None) or \
+            getattr(pipeline, "snapshot", None) or pipeline
+        if not callable(fn):
+            raise TypeError(f"register_pipeline needs a snapshot source, "
+                            f"got {pipeline!r}")
+        self.pipelines.append((str(name), fn))
+        return self
+
     # -- payload ------------------------------------------------------------
     def snapshot(self) -> dict:
         out = []
@@ -80,7 +96,8 @@ class WebStatus(Logger):
             })
         doc = {"workflows": out}
         for key, sources in (("serving", self.serving),
-                             ("health", self.health)):
+                             ("health", self.health),
+                             ("pipeline", self.pipelines)):
             section = {}
             for name, fn in sources:
                 try:
