@@ -224,6 +224,18 @@ def _emit(metric: str, value: float, forwards=None, batch: int = 0,
         m = flops.mfu(value, forwards, batch)
         if m is not None:
             out["mfu"] = round(m, 4)
+    # ISSUE 5: every scenario line carries the child's telemetry-plane
+    # snapshot (compact name{labels} -> value; zero series dropped) so a
+    # recorded bench artifact shows recompiles/stalls/step counts
+    # without rerunning anything
+    try:
+        from znicz_tpu.observe import REGISTRY
+        snap = REGISTRY.snapshot_flat()
+        if snap:
+            out["registry"] = snap
+    except Exception as exc:  # noqa: BLE001 — telemetry must not cost
+        print(f"# registry snapshot unavailable: {exc!r}",  # the line
+              file=sys.stderr)
     print(json.dumps(out), flush=True)
     return out
 
@@ -715,6 +727,99 @@ def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
         "prefetched metric history diverged from the synchronous run"
 
 
+def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
+                           n_valid=640, hidden=256, pairs=20):
+    """ISSUE 5 scenario: the telemetry plane's cost on the REAL
+    Workflow.run loop (CPU by design — it measures the instrumentation
+    machinery, not the chip).  Runs the same seeded mnist_fc-shaped
+    workflow with probes+tracer enabled vs ``observe.set_enabled(False)``
+    (the bare pre-ISSUE-5 walk).
+
+    Protocol, forced by this box's load profile: scheduler theft on the
+    shared sandbox swings individual runs ±10-40% (sampled runs sit at
+    ~24k sps with sporadic dips to ~14k), and theft only ever SLOWS a
+    run down — so per-run throughput is a one-sided underestimate of
+    the machine's capability.  The scenario interleaves many short
+    bare/inst runs, alternates which arm goes first to cancel order
+    bias, and compares the arms at their best-of-N (max) throughput:
+    with 20 samples per arm at least one run per arm lands nearly
+    clean, so max converges to each arm's true speed while percentile
+    statistics still straddle the dip population (p75 measured anywhere
+    from -0.5% to +7.3% overhead across identical reruns; best-of-N
+    held inside ±0.6%).  The per-pair median ratio and the raw ratio
+    spread ride along as diagnostics.  The line lands first; the <2%
+    overhead contract and the bit-exact metric-history contract are
+    ASSERTED after it flushes, so a violation still records the
+    measurement but fails the scenario loudly (nonzero child exit)."""
+    import statistics
+    import time as _time
+
+    from znicz_tpu import observe
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": hidden},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    ]
+    loader_cfg = {"n_classes": 10, "sample_shape": (28, 28),
+                  "n_train": n_train, "n_valid": n_valid,
+                  "minibatch_size": minibatch, "spread": 2.5,
+                  "noise": 1.0}
+
+    def run_once(enabled):
+        observe.set_enabled(enabled)
+        prng.seed_all(7)
+        w = StandardWorkflow(
+            name="overhead", layers=layers, loss_function="softmax",
+            loader_name="synthetic_classifier", loader_config=loader_cfg,
+            decision_config={"max_epochs": epochs})
+        w.initialize(device=TPUDevice())
+        t0 = _time.perf_counter()
+        w.run()
+        dt = _time.perf_counter() - t0
+        hist = w.decision.metrics_history
+        w.stop()
+        return (n_train + n_valid) * epochs / dt, hist
+
+    try:
+        run_once(True)                   # warm the compile cache once
+        run_once(False)
+        ratios, bare, inst = [], [], []
+        inst_hist = bare_hist = None
+        for i in range(pairs):
+            if i % 2:                    # alternate order: [b,s] / [s,b]
+                s, inst_hist = run_once(True)
+                b, bare_hist = run_once(False)
+            else:
+                b, bare_hist = run_once(False)
+                s, inst_hist = run_once(True)
+            bare.append(b)
+            inst.append(s)
+            ratios.append(s / b)
+    finally:
+        observe.set_enabled(True)
+    bare_sps = max(bare)
+    inst_sps = max(inst)
+    overhead_pct = (1.0 - inst_sps / bare_sps) * 100.0
+    _emit("metrics_overhead_instrumented_samples_per_sec", inst_sps,
+          cpu=True, bare_samples_per_sec=round(bare_sps, 1),
+          overhead_pct=round(overhead_pct, 3),
+          median_overhead_pct=round(
+              (1.0 - statistics.median(ratios)) * 100.0, 3),
+          bit_exact=inst_hist == bare_hist, epochs=epochs, pairs=pairs,
+          ratio_spread=[round(min(ratios), 3), round(max(ratios), 3)])
+    # AFTER the emit so the measurement always lands: a broken contract
+    # must fail the scenario loudly, not ride a JSON field nobody greps
+    assert inst_hist == bare_hist, \
+        "instrumented metric history diverged from the bare run"
+    assert overhead_pct < 2.0, \
+        f"instrumentation overhead {overhead_pct:.2f}% >= 2%"
+
+
 def child_main(mode: str) -> None:
     if mode == "pipeline":
         # input-pipeline scenario: CPU by design (measures the prefetch
@@ -733,6 +838,15 @@ def child_main(mode: str) -> None:
         jax.config.update("jax_platforms", "cpu")
         _enable_compile_cache()
         bench_serve()
+        return
+    if mode == "metrics_overhead":
+        # telemetry-plane scenario: CPU by design (measures the
+        # observe instrumentation through the real run loop)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_metrics_overhead()
         return
     if mode == "cpu_fallback":
         # the axon sitecustomize pins jax_platforms via jax.config at
@@ -798,18 +912,33 @@ def _run_child(mode: str, timeout: int, platform=None):
 
 def main():
     notes = []
+    # ISSUE 5 satellite: the r05 artifact tail showed the same metric
+    # line duplicated VERBATIM (the child re-emits its flagship for the
+    # standalone --child contract, and the parent's final re-emit could
+    # repeat an already-last line).  The parent now prints each distinct
+    # record once; a deliberate final re-emit that would repeat an
+    # earlier line is labeled {"reemit": true} instead of silently
+    # doubling the record.
+    printed: list[str] = []
+
+    def emit(r) -> None:
+        line = json.dumps(r)
+        if line not in printed:
+            print(line, flush=True)
+            printed.append(line)
+
     results, note = _run_child("tpu", TPU_TIMEOUT)
     if note:
         notes.append(note)
     for r in results:
-        print(json.dumps(r), flush=True)
+        emit(r)
 
     if not any(r["metric"].startswith("alexnet") for r in results):
         more, note = _run_child("tpu", TPU_RETRY_TIMEOUT)
         if note:
             notes.append(note)
         for r in more:
-            print(json.dumps(r), flush=True)
+            emit(r)
         results += more
 
     if not results:
@@ -828,18 +957,18 @@ def main():
             last_hw = _last_hw_snapshot()
             if last_hw:
                 r["last_hw"] = last_hw
-            print(json.dumps(r), flush=True)
+            emit(r)
 
-    # serving-plane + input-pipeline scenarios: their own CPU children
-    # (independent of the chip pool), BEFORE the final flagship re-emit
-    # so the driver's last-line contract is untouched
-    for extra_mode in ("serve", "pipeline"):
+    # serving-plane / input-pipeline / metrics-overhead scenarios: their
+    # own CPU children (independent of the chip pool), BEFORE the final
+    # flagship re-emit so the driver's last-line contract is untouched
+    for extra_mode in ("serve", "pipeline", "metrics_overhead"):
         extra_results, note = _run_child(extra_mode, CPU_TIMEOUT,
                                          platform="cpu")
         if note:
             notes.append(note)
         for r in extra_results:
-            print(json.dumps(r), flush=True)
+            emit(r)
 
     if results:
         # headline by NAME, not position: if the child was killed mid-tail
@@ -850,7 +979,12 @@ def main():
         best = flagships[-1] if flagships else results[-1]
         if notes and "fallback_reason" not in best:
             best["notes"] = "; ".join(notes)[:300]
-        print(json.dumps(best), flush=True)
+        if printed and printed[-1] == json.dumps(best):
+            pass            # already the last line — emitting once is
+        else:               # the whole point (ISSUE 5 satellite)
+            if json.dumps(best) in printed:
+                best["reemit"] = True   # labeled repeat, never verbatim
+            print(json.dumps(best), flush=True)
     else:
         print(json.dumps({
             "metric": "alexnet_b128_train_samples_per_sec_per_chip",

@@ -1,0 +1,70 @@
+"""Post-test scrape smoke for tools/t1.sh (ISSUE 5): boot a WebStatus,
+hit `/metrics` and `/trace.json` over real HTTP, and fail LOUDLY on a
+non-200 status, an unparseable body, or an empty registry/trace.  Kept
+jax-free (observe + web_status are stdlib-only) so the smoke costs
+milliseconds after a 10-minute tier-1 run.
+
+Exit 0 on success; any failure prints one `metrics_smoke:`-prefixed
+line to stderr and exits 1.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> None:
+    print(f"metrics_smoke: FAILED — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    from znicz_tpu import observe
+    from znicz_tpu.web_status import WebStatus
+
+    # exercise one of each instrument so the scrape carries live values
+    observe.counter("znicz_smoke_total", "t1.sh scrape smoke").inc()
+    with observe.span("smoke.step", step=1):
+        pass
+    observe.instant("smoke.event")
+
+    status = WebStatus(port=0)
+    port = status.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+
+        resp = urllib.request.urlopen(base + "/metrics", timeout=10)
+        if resp.status != 200:
+            fail(f"GET /metrics -> {resp.status}")
+        body = resp.read().decode()
+        type_lines = [ln for ln in body.splitlines()
+                      if ln.startswith("# TYPE znicz_")]
+        if not type_lines:
+            fail("GET /metrics served an EMPTY registry (no znicz_ "
+                 "family declarations)")
+        if "znicz_smoke_total 1" not in body:
+            fail("counter written before the scrape is missing from "
+                 "the exposition")
+
+        resp = urllib.request.urlopen(base + "/trace.json", timeout=10)
+        if resp.status != 200:
+            fail(f"GET /trace.json -> {resp.status}")
+        doc = json.load(resp)
+        names = {e.get("name") for e in doc.get("traceEvents", [])}
+        if not {"smoke.step", "smoke.event"} <= names:
+            fail(f"trace ring is missing the smoke events "
+                 f"(got {sorted(n for n in names if n)[:8]}...)")
+    finally:
+        status.stop()
+
+    print(f"metrics_smoke: ok — {len(type_lines)} registry families, "
+          f"{sum(1 for e in doc['traceEvents'] if e['ph'] != 'M')} "
+          f"trace events")
+
+
+if __name__ == "__main__":
+    main()

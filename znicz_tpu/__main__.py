@@ -5,6 +5,7 @@ Usage:
     python -m znicz_tpu <workflow.py> [config.py ...] [options]
     python -m znicz_tpu forge {list,upload,fetch} ...
     python -m znicz_tpu serve <package.npz> [options]
+    python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
 
 The workflow file must expose ``run(load, main)`` (every models/ sample
 does); config files are executed Python mutating the global ``root`` tree;
@@ -104,6 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "argument)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
+    p.add_argument("--trace", default=None, metavar="OUT_JSON",
+                   help="export the observe-plane span timeline (step "
+                        "spans + resilience/recompile instant events) "
+                        "as Chrome-trace JSON after the run — loads in "
+                        "Perfetto; the 'trace <out.json> <workflow.py>' "
+                        "subcommand form is shorthand for this")
     p.add_argument("--publish", default=None, metavar="BACKEND",
                    choices=("markdown", "html"),
                    help="write a post-training report (reference: "
@@ -183,6 +190,14 @@ def main(argv=None) -> int:
         from znicz_tpu.serve.server import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # observability shorthand: run the workflow, export its span
+        # timeline — `znicz_tpu trace out.json workflow.py [cfg ...]`
+        if len(argv) < 3:
+            print("usage: znicz_tpu trace <out.json> <workflow.py> "
+                  "[config.py ...] [options]", file=sys.stderr)
+            return 2
+        return main(list(argv[2:]) + ["--trace", argv[1]])
     args = build_parser().parse_args(argv)
     if args.coordinator is not None:
         multihost(args.coordinator, args.num_processes, args.process_id)
@@ -241,6 +256,11 @@ def main(argv=None) -> int:
         print(f"best config: {best}")
         return 0
     module.run(launcher.load, launcher.main)
+    if args.trace is not None:
+        from znicz_tpu import observe
+
+        n = observe.export_trace(args.trace)
+        print(f"trace: wrote {n} events -> {args.trace}")
     if args.publish is not None and launcher.workflow is not None:
         from znicz_tpu.utils.publishing import Publisher
         Publisher(backend=args.publish).publish(launcher.workflow)

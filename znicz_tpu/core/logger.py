@@ -2,20 +2,64 @@
 
 Every framework object mixes this in to get a named, lazily-created logger
 (``self.info(...)``, ``self.debug(...)``, ...).  The reference adds colored
-console output and an optional MongoDB sink; here the sink is stdlib logging
-(the host side of a TPU pod writes plain text / jsonl — see
-znicz_tpu.utils.metrics for structured metrics).
+console output and an optional MongoDB sink; here the sinks are stdlib
+logging: the human-readable console format by default, plus an opt-in
+JSONL structured stream (``configure(jsonl_path=...)``) so log lines and
+the observability plane's point events (znicz_tpu.observe.trace
+instants — faults, recompiles, restarts) share ONE machine-readable
+file a tool can tail.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import time
 
 
 _configured = False
+_jsonl_paths: set[str] = set()
+
+#: the observability plane's point events log through this name, so a
+#: JSONL sink interleaves them with ordinary log records
+EVENT_LOGGER = "znicz_tpu.events"
 
 
-def configure(level: int = logging.INFO) -> None:
+class JsonlHandler(logging.FileHandler):
+    """One JSON object per record: ``{"ts", "level", "logger", "msg"}``
+    plus an ``"event"``/``"args"`` pair when the record carries a
+    structured observe event (see :func:`event_log`)."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__(path, mode="a", delay=True)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            doc = {"ts": round(record.created, 6),
+                   "iso": time.strftime(
+                       "%Y-%m-%dT%H:%M:%S",
+                       time.localtime(record.created)),
+                   "level": record.levelname,
+                   "logger": record.name,
+                   "msg": record.getMessage()}
+            event = getattr(record, "observe_event", None)
+            if event is not None:
+                doc["event"] = event
+                doc["args"] = getattr(record, "observe_args", None)
+            stream = self.stream or self._open()
+            self.stream = stream
+            stream.write(json.dumps(doc) + "\n")
+            stream.flush()
+        except Exception:  # noqa: BLE001 — logging must never raise
+            self.handleError(record)
+
+
+def configure(level: int = logging.INFO,
+              jsonl_path: str | None = None) -> None:
+    """Idempotent logging setup.  The human console format installs
+    once; each distinct ``jsonl_path`` additionally attaches ONE
+    :class:`JsonlHandler` on the root logger (opt-in — the default
+    stays plain text)."""
     global _configured
     if not _configured:
         logging.basicConfig(
@@ -24,6 +68,27 @@ def configure(level: int = logging.INFO) -> None:
             datefmt="%H:%M:%S",
         )
         _configured = True
+    if jsonl_path and jsonl_path not in _jsonl_paths:
+        handler = JsonlHandler(jsonl_path)
+        handler.setLevel(level)
+        logging.getLogger().addHandler(handler)
+        # observe-plane events log at INFO on the dedicated events
+        # logger; when something else configured logging first the root
+        # may sit at WARNING, which would silently drop them before the
+        # sink — pin the events logger to the sink's level
+        events = logging.getLogger(EVENT_LOGGER)
+        if events.getEffectiveLevel() > level:
+            events.setLevel(level)
+        _jsonl_paths.add(jsonl_path)
+
+
+def event_log(name: str, args: dict | None) -> None:
+    """Observe-plane point events ride the logging tree (INFO on the
+    dedicated events logger, default-silent on console at WARNING-level
+    roots, captured verbatim by any JSONL sink)."""
+    logging.getLogger(EVENT_LOGGER).info(
+        "event %s", name,
+        extra={"observe_event": name, "observe_args": args or {}})
 
 
 class Logger:

@@ -28,6 +28,7 @@ from typing import TYPE_CHECKING, Dict, Optional
 
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.mutable import Bool, LinkableAttribute
+from znicz_tpu.observe import probe
 
 if TYPE_CHECKING:
     from znicz_tpu.core.workflow import Workflow
@@ -58,6 +59,7 @@ class Unit(Logger):
         self.run_was_called = False
         self._run_count = 0
         self._run_time = 0.0
+        self._observers = None   # cached registry children, first run
         if workflow is not None:
             workflow.add_unit(self)
 
@@ -155,7 +157,18 @@ class Unit(Logger):
         self.run()
         self.run_was_called = True
         self._run_count += 1
-        self._run_time += time.monotonic() - start
+        dt = time.monotonic() - start
+        self._run_time += dt
+        # donate per-unit timing to the shared telemetry plane — the
+        # registry children timing_table()/GET /metrics read.  Cached
+        # handles keep the hot path at one locked pair-increment.
+        if probe.enabled():
+            obs = self._observers
+            if obs is None:
+                wf = self.workflow
+                obs = self._observers = probe.unit_observers(
+                    wf.name if wf is not None else "", self.name)
+            probe.unit_run(obs, dt)
 
     @property
     def timing(self) -> tuple[int, float]:

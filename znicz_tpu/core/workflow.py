@@ -24,6 +24,8 @@ from typing import Optional
 
 from znicz_tpu.core.plumbing import EndPoint, StartPoint
 from znicz_tpu.core.units import Unit
+from znicz_tpu.observe import probe
+from znicz_tpu.observe.trace import TRACER
 from znicz_tpu.resilience.faults import fault_hook
 
 
@@ -52,6 +54,10 @@ class Workflow(Unit):
         if unit not in self.units:
             self.units.append(unit)
             unit.workflow = self
+            # drop registry children cached under the old workflow label
+            # (a unit that ran standalone or in another workflow would
+            # otherwise donate to the wrong series forever)
+            unit._observers = None
 
     def del_unit(self, unit: Unit) -> None:
         if unit in self.units:
@@ -109,6 +115,17 @@ class Workflow(Unit):
         if not self.initialized:
             raise RuntimeError("Workflow.run before initialize")
         started = time.monotonic()
+        # telemetry plane: per-delivery spans + step-latency histogram +
+        # recompile polling (observe.set_enabled(False) reduces the walk
+        # to the bare pre-ISSUE-5 loop; the metrics_overhead bench pins
+        # the instrumented-vs-bare gap at <2%)
+        observed = probe.enabled()
+        if observed:
+            probe.workflow_run(self.name)
+            run_t0 = time.perf_counter()
+            signals_before = self.signals_dispatched
+            span_args: dict[str, dict] = {}   # unit -> reusable trace
+            perf = time.perf_counter          # args (no per-signal dict)
         self.end_point.reached = False
         # clear fired-marks left by an early-terminated previous walk so join
         # units cannot fire on stale signals
@@ -126,7 +143,26 @@ class Workflow(Unit):
                 # global None check
                 fault_hook("workflow.step", workflow=self, unit=target)
                 self.signals_dispatched += 1
-                target._signal(source, queue)
+                if observed:
+                    t0 = perf()
+                    target._signal(source, queue)
+                    dt = perf() - t0
+                    probe.signal_dispatched(dt)
+                    tname = target.name
+                    a = span_args.get(tname)
+                    if a is None:
+                        a = span_args[tname] = {"unit": tname}
+                    TRACER.complete("workflow.step", t0, dt, a)
+                    # recompile poll rides a stride: polling every
+                    # watched program per signal has no business on the
+                    # per-signal budget (<2%, metrics_overhead bench); a
+                    # 32-delivery detection lag is invisible next to a
+                    # multi-second recompile, and the end-of-run check
+                    # below closes the final window
+                    if not self.signals_dispatched % 32:
+                        probe.check_recompiles()
+                else:
+                    target._signal(source, queue)
                 if self.end_point.reached:
                     break
         except BaseException:
@@ -134,7 +170,16 @@ class Workflow(Unit):
             # supervisor rebuilds fresh objects, so stop ours now
             for pipeline in self.pipelines:
                 pipeline.stop()
+            if observed:
+                probe.signals_add(self.signals_dispatched -
+                                  signals_before)
             raise
+        if observed:
+            probe.signals_add(self.signals_dispatched - signals_before)
+            probe.check_recompiles()
+            TRACER.complete("workflow.run", run_t0,
+                            time.perf_counter() - run_t0,
+                            workflow=self.name)
         self._wall_time += time.monotonic() - started
         self.run_was_called = True
 
@@ -150,8 +195,32 @@ class Workflow(Unit):
         are attached (docs/PIPELINE.md: ``prod_stall`` = producer waited
         for a free slot, ``cons_stall`` = consumer waited on an empty
         queue, ``stage_s`` = H2D staging time on the worker)."""
-        rows = sorted(((u._run_time, u._run_count, u.name) for u in self.units),
-                      reverse=True)
+        # the rows come from the shared metrics registry (the same
+        # series GET /metrics exposes as znicz_unit_run_seconds_total /
+        # znicz_unit_runs_total) — counters are process-lifetime, so
+        # after a supervised restart the table shows the cumulative cost
+        # across attempts, which is exactly what a restart storm inflates.
+        # Units keep their local timers either way; when the registry saw
+        # fewer runs than the unit did (the plane was disabled for some
+        # or all of the run) the local timer is the truth — without the
+        # fallback observe.set_enabled(False) would render an empty table
+        reg = {name: (secs, runs) for secs, runs, name in
+               probe.unit_timing_rows(self.name,
+                                      (u.name for u in self.units))}
+        local: dict[str, list] = {}
+        for u in self.units:
+            runs, secs = u.timing
+            acc = local.setdefault(u.name, [0.0, 0])
+            acc[0] += secs
+            acc[1] += runs
+        rows = []
+        for name, (lsecs, lruns) in local.items():
+            rsecs, rruns = reg.get(name, (0.0, 0))
+            if rruns >= lruns:
+                rows.append((rsecs, rruns, name))
+            else:
+                rows.append((lsecs, lruns, name))
+        rows.sort(reverse=True)
         total = sum(r[0] for r in rows) or 1e-12
         lines = [f"{'unit':<28}{'runs':>8}{'time_s':>10}{'share':>8}"]
         for run_time, count, name in rows:

@@ -1,0 +1,28 @@
+"""znicz_tpu.observe — the unified telemetry plane (ISSUE 5).
+
+One process-global metrics registry (``registry.REGISTRY``: Counter /
+Gauge / Histogram with labels, dict snapshots, Prometheus text
+exposition), one bounded-ring span tracer (``trace.TRACER``:
+``span()`` / ``instant()`` / Chrome-trace export), and the fixed
+instrumentation hooks the runtime calls (``probe``: per-step timing,
+recompile detection, staged-bytes accounting, resilience events).
+
+Scrape surfaces: ``WebStatus`` serves ``GET /metrics`` (Prometheus
+text) and ``GET /trace.json`` (ring dump); ``python -m znicz_tpu
+trace out.json workflow.py`` runs a workflow and exports its timeline;
+``bench.py`` attaches ``registry.snapshot_flat()`` to result lines.
+Metric name catalogue: docs/OBSERVABILITY.md.
+"""
+
+from znicz_tpu.observe.registry import (REGISTRY, Registry, counter,
+                                        gauge, histogram)
+from znicz_tpu.observe.trace import (TRACER, Tracer, export_trace,
+                                     instant, span)
+from znicz_tpu.observe.probe import (check_recompiles, enabled,
+                                     resilience_event, set_enabled,
+                                     staged_bytes, watch_compiles)
+
+__all__ = ["REGISTRY", "Registry", "counter", "gauge", "histogram",
+           "TRACER", "Tracer", "span", "instant", "export_trace",
+           "set_enabled", "enabled", "watch_compiles",
+           "check_recompiles", "staged_bytes", "resilience_event"]

@@ -1,0 +1,245 @@
+"""Automatic instrumentation hooks wiring the runtime into the registry
+and tracer (ISSUE 5 tentpole, part 3).
+
+The production code calls these at fixed sites, mirroring the
+resilience plane's ``fault_hook`` discipline:
+
+====================  =====================================================
+hook                  call site
+====================  =====================================================
+``unit_observers``    ``core/units.py :: Unit._timed_run`` — donates
+                      per-unit run counts/seconds into
+                      ``znicz_unit_runs_total`` / ``znicz_unit_run_
+                      seconds_total`` (labels: workflow, unit); the
+                      registry children ARE what ``timing_table()`` reads
+``step_histogram``    ``core/workflow.py`` run loop — per signal-delivery
+                      wall time into ``znicz_workflow_step_seconds``
+``watch_compiles`` /  ``parallel/step.py`` registers its jitted
+``check_recompiles``  functions; the workflow loop polls their
+                      ``_cache_size()`` sum — a positive delta increments
+                      ``znicz_recompiles_total{fn}`` and drops a
+                      ``compile.recompile`` instant on the trace timeline
+``staged_bytes``      ``pipeline/prefetcher.py`` worker — H2D staging
+                      volume (counter) + per-pipeline live gauges
+``resilience_event``  ``resilience/{faults,retry,supervisor,health}.py``
+                      — every fault firing / retry / restart / NaN-guard
+                      action lands as a counter increment AND an instant
+                      event, so failures correlate with steps on one
+                      timeline
+====================  =====================================================
+
+All hooks early-out on ``observe.set_enabled(False)`` (one module-global
+load), which is how the ``metrics_overhead`` bench measures the bare
+path and how determinism tests pin "instrumentation off == seed path".
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+from znicz_tpu.observe import registry as _reg
+from znicz_tpu.observe import trace as _trace
+
+# -- enable/disable (module-global; also flips the tracer) -------------------
+
+_enabled = True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(flag: bool) -> None:
+    """Master switch for every automatic probe AND the global tracer —
+    registry families stay registered (their values simply stop moving),
+    so a scrape during a disabled window still parses."""
+    global _enabled
+    _enabled = bool(flag)
+    if flag:
+        _trace.TRACER.enable()
+    else:
+        _trace.TRACER.disable()
+
+
+# -- workflow plane ----------------------------------------------------------
+
+_UNIT_RUNS = _reg.counter(
+    "znicz_unit_runs_total", "control-graph unit firings",
+    labelnames=("workflow", "unit"))
+_UNIT_SECONDS = _reg.counter(
+    "znicz_unit_run_seconds_total", "wall seconds inside unit.run()",
+    labelnames=("workflow", "unit"))
+_STEP_SECONDS = _reg.histogram(
+    "znicz_workflow_step_seconds",
+    "wall time of one control-graph signal delivery")
+_SIGNALS = _reg.counter(
+    "znicz_workflow_signals_total", "control-graph signals dispatched")
+_WORKFLOW_RUNS = _reg.counter(
+    "znicz_workflow_runs_total", "Workflow.run invocations",
+    labelnames=("workflow",))
+
+
+def unit_observers(workflow_name: str, unit_name: str):
+    """(runs_counter, seconds_counter) children for one unit — cached by
+    the unit itself so the hot path is one :func:`unit_run` call."""
+    return (_UNIT_RUNS.labels(workflow=workflow_name, unit=unit_name),
+            _UNIT_SECONDS.labels(workflow=workflow_name, unit=unit_name))
+
+
+def unit_run(obs, dt_s: float) -> None:
+    """Donate one unit firing: both children share the registry lock, so
+    taking it ONCE for the pair halves the hot-path lock traffic (the
+    metrics_overhead budget is per-microsecond at signal granularity)."""
+    runs, secs = obs
+    with runs._lock:
+        runs.value += 1.0
+        secs.value += dt_s
+
+
+def unit_timing_rows(workflow_name: str, unit_names) -> list:
+    """``timing_table()``'s data source: ``(seconds, runs, unit)`` rows
+    from the registry for one workflow's units.  Counters are
+    process-lifetime (Prometheus semantics), so a supervised restart's
+    table shows the CUMULATIVE cost across attempts — by design: that is
+    the number a restart storm inflates.  Units sharing a name merge."""
+    rows = []
+    for name in dict.fromkeys(unit_names):          # dedupe, keep order
+        runs = _UNIT_RUNS.labels(workflow=workflow_name, unit=name).get()
+        secs = _UNIT_SECONDS.labels(workflow=workflow_name,
+                                    unit=name).get()
+        rows.append((secs, int(runs), name))
+    return rows
+
+
+def step_histogram():
+    return _STEP_SECONDS
+
+
+def signal_dispatched(dt_s: float) -> None:
+    """One control-graph delivery took ``dt_s`` wall seconds.  Only the
+    histogram moves per signal; ``znicz_workflow_signals_total`` is
+    batch-incremented per run (:func:`signals_add`) — one fewer lock
+    round-trip on the per-signal path."""
+    _STEP_SECONDS.observe(dt_s)
+
+
+def signals_add(n: int) -> None:
+    """Batch-donate ``n`` dispatched signals (called once per
+    Workflow.run with the walk's delta)."""
+    if n:
+        _SIGNALS.inc(n)
+
+
+def workflow_run(workflow_name: str) -> None:
+    _WORKFLOW_RUNS.labels(workflow=workflow_name).inc()
+
+
+# -- recompile detection -----------------------------------------------------
+
+_RECOMPILES = _reg.counter(
+    "znicz_recompiles_total",
+    "XLA compile-cache growth observed on watched jitted functions",
+    labelnames=("fn",))
+
+#: key -> [tuple of weakrefs to jitted fns, last observed cache-size
+#: sum, metric label].  Weak refs: a watched step that dies (dropped
+#: workflow, supervised-restart rebuild) stops being polled and its
+#: entry is reaped on the next poll, so two live steps never fight over
+#: one key and dead ones never pin their compiled programs in memory.
+_watched: dict[str, list] = {}
+
+
+def watch_compiles(key: str, *fns, label: Optional[str] = None) -> None:
+    """Register jitted function(s) for compile-cache delta polling.
+    ``key`` must be unique per watched OBJECT (two live FusedTrainSteps
+    in one process each keep their own watch); ``label`` is the
+    ``znicz_recompiles_total{fn=...}`` label and defaults to ``key`` —
+    instances of one class share a label while keeping separate
+    baselines.  Functions without ``_cache_size`` (older jax, non-jit
+    callables) are ignored.  A warm function registers its current
+    cache size as the baseline, so only growth counts."""
+    refs = tuple(weakref.ref(f) for f in fns
+                 if hasattr(f, "_cache_size"))
+    if not refs:
+        return
+    _watched[key] = [refs, _cache_total(refs), label or key]
+
+
+def unwatch_compiles(key: str) -> None:
+    _watched.pop(key, None)
+
+
+def _cache_total(refs) -> Optional[int]:
+    """Cache-size sum over the still-living functions; None when every
+    ref is dead (the entry should be reaped)."""
+    total, alive = 0, False
+    for ref in refs:
+        fn = ref()
+        if fn is None:
+            continue
+        alive = True
+        try:
+            total += int(fn._cache_size())
+        except Exception:  # noqa: BLE001 — a torn-down backend must not
+            pass           # crash the run loop polling it
+    return total if alive else None
+
+
+def check_recompiles() -> int:
+    """Poll watched functions; returns newly observed compiles.  The
+    FIRST compile of a fresh function counts too — a steady-state loop
+    asserts the counter moves exactly once per function, and the pinned
+    zero-recompile tests keep holding because they compare cache sizes
+    directly."""
+    if not _watched or not _enabled:
+        return 0
+    new = 0
+    for key, entry in list(_watched.items()):
+        total = _cache_total(entry[0])
+        if total is None:                 # every watched fn died
+            _watched.pop(key, None)
+            continue
+        delta = total - entry[1]
+        if delta > 0:
+            entry[1] = total
+            new += delta
+            _RECOMPILES.labels(fn=entry[2]).inc(delta)
+            _trace.instant("compile.recompile", fn=entry[2], new=delta,
+                           cache_size=total)
+        elif delta < 0:
+            # a subset of the fns died (or a cache was cleared): rebase
+            # so the shrink is not later mistaken for absence of growth
+            entry[1] = total
+    return new
+
+
+# -- pipeline plane ----------------------------------------------------------
+
+_BYTES_STAGED = _reg.counter(
+    "znicz_pipeline_bytes_staged_total",
+    "host bytes shipped through prefetch stagers")
+
+
+def staged_bytes(nbytes: int) -> None:
+    if _enabled:
+        _BYTES_STAGED.inc(nbytes)
+
+
+# -- resilience plane --------------------------------------------------------
+
+_RESILIENCE = _reg.counter(
+    "znicz_resilience_events_total",
+    "resilience-plane events (fault fired, retry, restart, hang, "
+    "nan_guard, snapshot_resume)", labelnames=("kind", "site"))
+
+
+def resilience_event(kind: str, site: str = "", **args) -> None:
+    """Counter + same-timeline instant event for one resilience action.
+    ``kind``: fault | retry | restart | hang | nan_guard |
+    snapshot_resume; ``site`` is the fault-plan site / fn name / '' when
+    not site-shaped."""
+    if not _enabled:
+        return
+    _RESILIENCE.labels(kind=kind, site=site).inc()
+    _trace.instant(f"resilience.{kind}", site=site, **args)

@@ -1,0 +1,353 @@
+"""Process-global metrics registry — the shared schema every subsystem
+donates into (ISSUE 5 tentpole).
+
+Before this module the tree had four ad-hoc telemetry surfaces
+(``Workflow.timing_table()`` strings, ``PipelineStats``,
+``serve/metrics.py::ServingMetrics``, per-subsystem ``WebStatus``
+JSON blocks) with no common schema and nothing scrapeable.  This is the
+one substrate: three metric kinds modeled on the Prometheus data model —
+
+- :class:`Counter` — monotonically increasing float (``inc``);
+- :class:`Gauge`   — settable level (``set``/``inc``/``dec``), or a
+  zero-arg callable evaluated at scrape time (``set_function``);
+- :class:`Histogram` — fixed upper-bound buckets (``observe``), exposed
+  with cumulative bucket counts plus ``_sum``/``_count`` so a scraper
+  can run ``histogram_quantile`` over it.
+
+Families support labels (declared at creation, ``labels(**kv)`` returns
+the per-labelset child).  Getters are get-or-create and idempotent, so
+any module can say ``counter("znicz_x_total")`` without ordering
+concerns; re-declaring with a different type or label set is an error.
+
+Everything is stdlib; one registry-wide lock guards both family
+creation and child mutation (hot-path cost: one uncontended lock + one
+float add, ~1 µs — the ``metrics_overhead`` bench scenario pins the
+end-to-end cost at <2 %).  Counters are process-lifetime monotonic,
+exactly like a real Prometheus client: a supervised restart keeps
+counting, which is what makes restart storms visible on a dashboard.
+
+Export surfaces: ``snapshot()`` (structured dict, merged into
+``WebStatus.snapshot()`` under ``"metrics"``), ``snapshot_flat()``
+(compact ``name{labels} -> number`` dict, attached to bench JSON
+lines), and ``render_prometheus()`` (text exposition served by
+``GET /metrics``).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Callable, Optional, Sequence
+
+#: default buckets for second-valued histograms: 100 µs (a no-op unit
+#: fire) .. 60 s (a cold XLA compile inside a step); beyond -> +Inf.
+SECONDS_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0)
+
+_TYPES = ("counter", "gauge", "histogram")
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape(v)}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One (family, labelset) time series.  All mutation goes through the
+    owning registry's lock (passed in) — a single shared lock keeps the
+    hot path allocation-free."""
+
+    __slots__ = ("_lock", "value", "fn", "counts", "sum", "count",
+                 "_edges")
+
+    def __init__(self, lock: threading.Lock,
+                 edges: Optional[tuple] = None) -> None:
+        self._lock = lock
+        self.value = 0.0
+        self.fn: Optional[Callable[[], float]] = None
+        self._edges = edges
+        if edges is not None:
+            self.counts = [0] * (len(edges) + 1)
+            self.sum = 0.0
+            self.count = 0
+
+    # counter / gauge -------------------------------------------------------
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value -= amount
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+            self.fn = None
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Gauge evaluated at scrape time (e.g. a QPS window or a live
+        queue depth owned by another object)."""
+        with self._lock:
+            self.fn = fn
+
+    def get(self) -> float:
+        # the callable runs OUTSIDE the registry lock: scrape-time
+        # providers (e.g. ServingMetrics.qps) take their own locks, and
+        # their event hooks take the registry lock — evaluating under
+        # ours would invert the order and deadlock
+        with self._lock:
+            fn = self.fn
+            value = self.value
+        if fn is not None:
+            try:
+                return float(fn())
+            except Exception:  # noqa: BLE001 — a dead provider must
+                return float("nan")        # not kill the scrape
+        return value
+
+    # histogram -------------------------------------------------------------
+    def observe(self, value: float) -> None:
+        # bisect_left == first edge >= value — the "value <= edge"
+        # bucket (C-speed: this runs once per control-graph signal)
+        i = bisect_left(self._edges, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value
+
+    def hist_dict(self) -> dict:
+        with self._lock:
+            return {"count": self.count, "sum": self.sum,
+                    "buckets": {("+Inf" if i == len(self._edges)
+                                 else f"{self._edges[i]:g}"): c
+                                for i, c in enumerate(self.counts)}}
+
+
+class _Family:
+    """A named metric family: type + help + label schema + children."""
+
+    __slots__ = ("name", "type", "help", "labelnames", "buckets",
+                 "_children", "_lock")
+
+    def __init__(self, name: str, mtype: str, help_: str,
+                 labelnames: tuple, lock: threading.Lock,
+                 buckets: Optional[tuple] = None) -> None:
+        self.name = name
+        self.type = mtype
+        self.help = help_
+        self.labelnames = labelnames
+        self.buckets = buckets
+        self._children: dict[tuple, _Child] = {}
+        self._lock = lock
+        if not labelnames:
+            self._children[()] = _Child(lock, buckets)
+
+    def labels(self, **kv) -> _Child:
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(kv))}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    key, _Child(self._lock, self.buckets))
+        return child
+
+    # label-less convenience: the family proxies its single child --------
+    def _solo(self) -> _Child:
+        if self.labelnames:
+            raise ValueError(f"metric {self.name!r} has labels "
+                             f"{self.labelnames}; use .labels(...)")
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._solo().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._solo().set_function(fn)
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    def get(self) -> float:
+        return self._solo().get()
+
+    def items(self):
+        return list(self._children.items())
+
+
+class Registry:
+    """Named families, one lock, three export formats."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    # -- declaration (get-or-create, idempotent) ----------------------------
+    def _family(self, name: str, mtype: str, help_: str,
+                labelnames: Sequence[str],
+                buckets: Optional[Sequence[float]] = None) -> _Family:
+        labelnames = tuple(labelnames)
+        buckets = tuple(float(b) for b in buckets) if buckets else None
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(
+                    name, mtype, help_, labelnames, self._lock, buckets)
+                return fam
+        if fam.type != mtype:
+            raise ValueError(f"metric {name!r} already registered as "
+                             f"{fam.type}, not {mtype}")
+        if fam.labelnames != labelnames:
+            raise ValueError(f"metric {name!r} already registered with "
+                             f"labels {fam.labelnames}, not {labelnames}")
+        if mtype == "histogram" and fam.buckets != buckets:
+            raise ValueError(f"metric {name!r} already registered with "
+                             f"buckets {fam.buckets}, not {buckets} — "
+                             f"observations would land in edges the "
+                             f"second declarer never asked for")
+        return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> _Family:
+        return self._family(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> _Family:
+        return self._family(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = SECONDS_BUCKETS) -> _Family:
+        return self._family(name, "histogram", help, labelnames, buckets)
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def clear(self) -> None:
+        """Drop every family — TESTS ONLY (cached child handles held by
+        long-lived objects keep writing into orphaned children)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- export --------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Structured dict: name -> {type, help, values: [{labels, value}]}.
+        Histogram values are {count, sum, buckets} dicts."""
+        with self._lock:
+            fams = list(self._families.values())
+        out = {}
+        for fam in fams:
+            values = []
+            for key, child in fam.items():
+                labels = dict(zip(fam.labelnames, key))
+                if fam.type == "histogram":
+                    values.append({"labels": labels,
+                                   "value": child.hist_dict()})
+                else:
+                    values.append({"labels": labels, "value": child.get()})
+            out[fam.name] = {"type": fam.type, "help": fam.help,
+                             "values": values}
+        return out
+
+    def snapshot_flat(self, skip_zero: bool = True) -> dict:
+        """Compact ``name{labels} -> number`` dict (histograms contribute
+        ``_count`` and ``_sum``) — the per-scenario snapshot bench.py
+        attaches to its JSON result lines.  ``skip_zero`` drops
+        never-touched series so artifact lines stay small."""
+        with self._lock:
+            fams = list(self._families.values())
+        out = {}
+        for fam in fams:
+            for key, child in fam.items():
+                ls = _label_str(fam.labelnames, key)
+                if fam.type == "histogram":
+                    h = child.hist_dict()
+                    if skip_zero and h["count"] == 0:
+                        continue
+                    out[f"{fam.name}_count{ls}"] = h["count"]
+                    out[f"{fam.name}_sum{ls}"] = round(h["sum"], 6)
+                else:
+                    v = child.get()
+                    if skip_zero and v == 0.0:
+                        continue
+                    out[f"{fam.name}{ls}"] = round(v, 6)
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4 — the ``GET /metrics``
+        body.  Stable ordering: families in registration order, children
+        in creation order."""
+        with self._lock:
+            fams = list(self._families.values())
+        lines = []
+        for fam in fams:
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.type}")
+            for key, child in fam.items():
+                if fam.type == "histogram":
+                    h = child.hist_dict()
+                    acc = 0
+                    for edge, c in h["buckets"].items():
+                        acc += c
+                        names = tuple(fam.labelnames) + ("le",)
+                        vals = key + (edge,)
+                        lines.append(
+                            f"{fam.name}_bucket"
+                            f"{_label_str(names, vals)} {acc}")
+                    ls = _label_str(fam.labelnames, key)
+                    lines.append(f"{fam.name}_sum{ls} {_fmt(h['sum'])}")
+                    lines.append(f"{fam.name}_count{ls} {h['count']}")
+                else:
+                    ls = _label_str(fam.labelnames, key)
+                    lines.append(f"{fam.name}{ls} {_fmt(child.get())}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    # NaN/inf reach here via dead scrape-time gauge providers — Prometheus
+    # text accepts them spelled out, and int(nan) would raise
+    if f != f or f in (float("inf"), float("-inf")):
+        return repr(f)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+#: THE process-global registry (the Prometheus default-registry shape).
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> _Family:
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "",
+          labelnames: Sequence[str] = ()) -> _Family:
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = SECONDS_BUCKETS) -> _Family:
+    return REGISTRY.histogram(name, help, labelnames, buckets)

@@ -778,6 +778,21 @@ class FusedTrainStep(Unit):
                     f"unit(s) {offenders} would silently coarsen to "
                     f"per-pass schedules; use by_epoch=True or disable "
                     f"scan_epoch")
+        # telemetry plane: donate the compiled programs to the recompile
+        # probe — the workflow run loop polls their compile-cache sizes,
+        # so an unexpected mid-run recompile lands as a counter increment
+        # plus an instant event on the step timeline.  Keyed per
+        # INSTANCE (two live steps keep separate watches; the probe
+        # holds weakrefs, so a dropped step reaps its own entry) while
+        # the metric label stays the class name.
+        from znicz_tpu.observe import probe as _probe
+        fns = [getattr(self, n, None) for n in
+               ("_train_fn", "_eval_fn", "_grad_fn", "_apply_fn",
+                "_train_fn_idx", "_eval_fn_idx", "_grad_fn_idx",
+                "_scan_fn")] + list(self._scan_idx_fns.values())
+        _probe.watch_compiles(f"{type(self).__name__}-{id(self):x}",
+                              *(f for f in fns if f is not None),
+                              label=type(self).__name__)
         self.initialized = True
 
     def _pin_dataset(self) -> None:

@@ -48,7 +48,33 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from znicz_tpu.observe import probe
+from znicz_tpu.observe import registry as _metrics
 from znicz_tpu.resilience.faults import fault_hook
+
+# shared-registry mirror of PipelineStats (ISSUE 5): the instance stats
+# below stay the per-pipeline single-writer truth (tests pin snapshot());
+# these process-wide series are what GET /metrics scrapes — stall seconds
+# aggregate across pipelines, the fill gauge tracks the live queue
+_M_PRODUCED = _metrics.counter("znicz_pipeline_batches_produced_total",
+                               "batches the prefetch workers queued")
+_M_CONSUMED = _metrics.counter("znicz_pipeline_batches_consumed_total",
+                               "prefetched batches the consumers popped")
+_M_SERVE = _metrics.counter("znicz_pipeline_serve_seconds_total",
+                            "host serve+fill seconds on prefetch workers")
+_M_STAGE = _metrics.counter("znicz_pipeline_stage_seconds_total",
+                            "device_put staging seconds on workers")
+_M_PROD_STALL = _metrics.counter(
+    "znicz_pipeline_producer_starved_seconds_total",
+    "workers waited for a free queue slot")
+_M_CONS_STALL = _metrics.counter(
+    "znicz_pipeline_consumer_starved_seconds_total",
+    "consumers waited on an empty queue")
+_M_BARRIER = _metrics.counter(
+    "znicz_pipeline_barrier_seconds_total",
+    "epoch-boundary determinism parks on workers")
+_M_FILL = _metrics.gauge("znicz_pipeline_queue_fill",
+                         "prefetch queue occupancy after the last event")
 
 
 class PrefetcherStopped(RuntimeError):
@@ -196,13 +222,21 @@ class BatchPrefetcher:
                 if not loader.serve_indices_only:
                     arrays = loader.fill_batch(rec["indices"], rec["size"])
                 loader._complete_record(rec)
-                self.stats.serve_s += time.perf_counter() - t0
+                serve_dt = time.perf_counter() - t0
+                self.stats.serve_s += serve_dt
+                observed = probe.enabled()
+                if observed:
+                    _M_SERVE.inc(serve_dt)
                 staged = None
                 if self._stager is not None:
                     t0 = time.perf_counter()
                     staged, nbytes = self._stager(rec, arrays)
-                    self.stats.stage_s += time.perf_counter() - t0
+                    stage_dt = time.perf_counter() - t0
+                    self.stats.stage_s += stage_dt
                     self.stats.bytes_staged += int(nbytes)
+                    if observed:
+                        _M_STAGE.inc(stage_dt)
+                        probe.staged_bytes(int(nbytes))
                 batch = StagedBatch(rec, arrays, staged)
                 t0 = time.perf_counter()
                 while not self._stop.is_set():
@@ -213,18 +247,26 @@ class BatchPrefetcher:
                         continue
                 else:
                     return
-                self.stats.producer_starved_s += time.perf_counter() - t0
+                stall_dt = time.perf_counter() - t0
+                self.stats.producer_starved_s += stall_dt
                 self.stats.produced += 1
                 fill = self._queue.qsize()
                 if fill > self.stats.max_fill:
                     self.stats.max_fill = fill
+                if observed:
+                    _M_PROD_STALL.inc(stall_dt)
+                    _M_PRODUCED.inc()
+                    _M_FILL.set(fill)
                 if rec["epoch_ended"]:
                     # determinism barrier: hold the post-boundary state
                     # (reshuffled order, advanced epoch) frozen until the
                     # consumer-side snapshotter has had its window
                     t0 = time.perf_counter()
                     self._barrier_sem.acquire()
-                    self.stats.barrier_s += time.perf_counter() - t0
+                    barrier_dt = time.perf_counter() - t0
+                    self.stats.barrier_s += barrier_dt
+                    if observed:
+                        _M_BARRIER.inc(barrier_dt)
         except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
             self._error = exc
 
@@ -249,8 +291,12 @@ class BatchPrefetcher:
             except queue.Empty:
                 if self._error is not None:
                     raise self._error
-        self.stats.consumer_starved_s += time.perf_counter() - t0
+        stall_dt = time.perf_counter() - t0
+        self.stats.consumer_starved_s += stall_dt
         self.stats.consumed += 1
+        if probe.enabled():
+            _M_CONS_STALL.inc(stall_dt)
+            _M_CONSUMED.inc()
         if batch.record["epoch_ended"]:
             self._pending_release = True
         return batch

@@ -55,6 +55,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from znicz_tpu.observe import probe as _probe
+
 
 class FaultInjected(RuntimeError):
     """An armed ``crash`` fault fired (simulated process death)."""
@@ -161,6 +163,11 @@ class FaultPlan:
                 self._record(fault, hit)
         if fault is None:
             return
+        # telemetry plane: every firing lands as a counter + an instant
+        # event on the step timeline (emitted OUTSIDE the plan lock —
+        # the registry/tracer must never nest under it)
+        _probe.resilience_event("fault", site=site, action=fault.action,
+                                hit=hit)
         if fault.action == "crash":
             raise FaultInjected(f"injected crash at {site} hit {hit}")
         if fault.action == "oserror":
@@ -190,6 +197,7 @@ class FaultPlan:
                 self._record(fault, hit)
         if fault is None:
             return value
+        _probe.resilience_event("fault", site=site, action="nan", hit=hit)
         return _nan_like(value)
 
 

@@ -41,6 +41,7 @@ import math
 import numpy as np
 
 from znicz_tpu.core.units import Unit
+from znicz_tpu.observe import probe as _probe
 
 
 class HealthGuard(Unit):
@@ -151,6 +152,8 @@ class HealthGuard(Unit):
             return
         self.nan_trips += 1
         self.last_trip_run = self._runs
+        _probe.resilience_event("nan_guard", action=self.mode,
+                                run=self._runs, trip=self.nan_trips)
         if self.mode == "skip":
             # the candidate may be the poison itself (captured after the
             # update this metric is now flagging) — drop it

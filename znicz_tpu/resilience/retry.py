@@ -22,6 +22,8 @@ from typing import Callable, Optional, Tuple, Type
 
 import numpy as np
 
+from znicz_tpu.observe import probe as _probe
+
 
 class AttemptTimeout(Exception):
     """One attempt exceeded the policy's per-attempt ``timeout``.
@@ -116,10 +118,16 @@ class RetryPolicy:
             self.total_attempts += 1
             try:
                 return self._attempt(fn, args, kwargs)
-            except (self.retryable + (AttemptTimeout,)):
+            except (self.retryable + (AttemptTimeout,)) as exc:
                 if attempt == self.max_attempts:
                     raise
                 self.total_retries += 1
+                # telemetry plane: each retry is a counter + timeline
+                # instant so flaky-I/O storms correlate with the steps
+                # they stall
+                _probe.resilience_event(
+                    "retry", site=getattr(fn, "__name__", repr(fn)),
+                    attempt=attempt, error=type(exc).__name__)
                 d = self.delay_for(attempt)
                 self.last_delays.append(d)
                 self._sleep(d)

@@ -36,6 +36,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.observe import probe as _probe
 from znicz_tpu.resilience import faults
 from znicz_tpu.snapshotter import restore_state, verify_snapshot
 
@@ -229,6 +230,8 @@ def run_supervised(workflow_factory: Callable, snap_dir: str,
         if snap is not None:
             restore_state(workflow, snap)
             report.resumed_from.append(snap)
+            _probe.resilience_event("snapshot_resume", attempt=attempt,
+                                    snapshot=os.path.basename(snap))
             log.info(f"supervisor: attempt {attempt} resumes from {snap}")
         error: Optional[BaseException] = None
         if policy.step_timeout is None:
@@ -249,8 +252,13 @@ def run_supervised(workflow_factory: Callable, snap_dir: str,
         if isinstance(error, StepHangError) or \
                 isinstance(error, faults.HangInterrupted):
             report.hang_events += 1
+            _probe.resilience_event("hang", attempt=attempt)
         report.failures.append(repr(error))
         report.restarts += 1
+        # restart on the shared timeline: the instant sits between the
+        # last step span of the crashed attempt and the first of the next
+        _probe.resilience_event("restart", attempt=attempt,
+                                error=type(error).__name__)
         log.warning(f"supervisor: attempt {attempt} failed: {error!r}")
         if report.restarts > policy.max_restarts:
             raise SupervisorExhausted(

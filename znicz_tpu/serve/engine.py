@@ -23,6 +23,7 @@ import threading
 
 import numpy as np
 
+from znicz_tpu import observe
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.resilience.faults import fault_hook
 
@@ -136,15 +137,24 @@ class BatchEngine(Logger):
         if bucket != n:
             pad = np.zeros((bucket - n,) + x.shape[1:], np.float32)
             x = np.concatenate([x, pad], axis=0)
+        compiled = False
         with self._lock:
             if self.static_shapes and bucket not in self._seen_buckets:
                 self._seen_buckets.add(bucket)
                 self.compile_count += 1
+                compiled = True
                 self.debug(f"compiling bucket {bucket} "
                            f"({self.compile_count}/{len(self.buckets)})")
             y = np.asarray(self.model(x))
             self.run_count += 1
             self.rows_served += n
+        if compiled and observe.enabled():
+            # shared telemetry plane: a bucket materializing after warmup
+            # is the steady-state-recompile smell the serve bench asserts
+            # against — make it scrapeable and visible on the timeline
+            observe.counter("znicz_serve_engine_compiles_total",
+                            "engine buckets compiled").inc()
+            observe.instant("serve.compile", bucket=bucket)
         return y[:n]
 
     def stats(self) -> dict:

@@ -17,11 +17,36 @@ import threading
 import time
 from collections import deque
 
+from znicz_tpu.observe import probe as _probe
+from znicz_tpu.observe import registry as _metrics
+
 #: Fixed latency bucket upper bounds in milliseconds.  Spanning 0.5 ms
 #: (in-process hits on a warm engine) to 8 s (drain under overload);
 #: requests beyond the last edge land in the +Inf bucket.
 LATENCY_BUCKETS_MS = (
     0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 4000, 8000)
+
+# shared-registry mirror (ISSUE 5): the per-instance snapshot() below
+# stays the /status.json wire schema; these donate the same events into
+# the process-global plane GET /metrics scrapes.  Counters aggregate
+# across ServingMetrics instances (process-lifetime, Prometheus
+# semantics); the QPS/queue-depth gauges follow the newest instance —
+# one serving plane per process is the deployed shape.
+_M_REQUESTS = _metrics.counter(
+    "znicz_serve_requests_total", "serving requests by outcome",
+    labelnames=("event",))
+_M_LATENCY = _metrics.histogram(
+    "znicz_serve_latency_seconds", "request latency (admit -> complete)",
+    buckets=tuple(b / 1000.0 for b in LATENCY_BUCKETS_MS))
+_M_BATCHES = _metrics.counter(
+    "znicz_serve_batches_total", "coalesced engine batches dispatched")
+_M_BATCH_ROWS = _metrics.counter(
+    "znicz_serve_batch_rows_total", "rows across coalesced batches")
+_M_QUEUE = _metrics.gauge("znicz_serve_queue_depth",
+                          "admitted chunks awaiting service")
+_M_QPS = _metrics.gauge("znicz_serve_qps",
+                        "completions/sec over the sliding window "
+                        "(newest serving plane)")
 
 
 class LatencyHistogram:
@@ -109,33 +134,54 @@ class ServingMetrics:
         self.batch_sizes: dict[int, int] = {}   # coalesced batch -> count
         self.latency = LatencyHistogram()
         self._recent: deque = deque()           # completion stamps
+        _M_QPS.set_function(self.qps)           # newest instance wins
 
     # -- event hooks (called by batcher / server) ---------------------------
+    # registry mirrors honor the observe master switch like every other
+    # probe (probe.set_enabled(False) => the instance counters keep
+    # serving /status.json but the shared plane stops moving and the
+    # per-request hot path drops the global-registry lock traffic)
     def on_admit(self, n_chunks: int = 1) -> None:
         with self._lock:
             self.admitted += 1
             self.queue_depth += n_chunks
+            depth = self.queue_depth
+        if _probe.enabled():
+            _M_QUEUE.set(depth)
+            _M_REQUESTS.labels(event="admitted").inc()
 
     def on_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+        if _probe.enabled():
+            _M_REQUESTS.labels(event="rejected").inc()
 
     def on_dequeue(self, n_chunks: int = 1) -> None:
         with self._lock:
             self.queue_depth = max(0, self.queue_depth - n_chunks)
+            depth = self.queue_depth
+        if _probe.enabled():
+            _M_QUEUE.set(depth)
 
     def on_timeout(self) -> None:
         with self._lock:
             self.timed_out += 1
+        if _probe.enabled():
+            _M_REQUESTS.labels(event="timed_out").inc()
 
     def on_error(self) -> None:
         with self._lock:
             self.errors += 1
+        if _probe.enabled():
+            _M_REQUESTS.labels(event="error").inc()
 
     def on_batch(self, batch_rows: int) -> None:
         with self._lock:
             self.batch_sizes[batch_rows] = \
                 self.batch_sizes.get(batch_rows, 0) + 1
+        if _probe.enabled():
+            _M_BATCHES.inc()
+            _M_BATCH_ROWS.inc(batch_rows)
 
     def on_complete(self, latency_s: float) -> None:
         now = time.monotonic()
@@ -146,6 +192,9 @@ class ServingMetrics:
             cutoff = now - self.WINDOW_S
             while self._recent and self._recent[0] < cutoff:
                 self._recent.popleft()
+        if _probe.enabled():
+            _M_REQUESTS.labels(event="completed").inc()
+            _M_LATENCY.observe(latency_s)
 
     # -- export -------------------------------------------------------------
     def qps(self) -> float:

@@ -4,9 +4,13 @@ The reference ran a tornado dashboard aggregating running workflows'
 progress over ZMQ (SURVEY.md §3.3 Web status row).  The rebuild is a
 minimal in-process HTTP endpoint on the TPU-VM host: ``/status.json``
 reports every registered workflow's name, epoch, metrics history and
-per-unit timing; ``/`` renders a plain HTML table of the same.  Stdlib
+per-unit timing; ``/metrics`` serves the process-global telemetry
+registry in Prometheus text exposition format (scrapeable);
+``/trace.json`` dumps the global tracer's ring buffer as Chrome-trace
+JSON (loads in Perfetto); ``/`` renders a plain HTML table.  Stdlib
 ``http.server`` on a daemon thread — zero dependencies, CLI ``-s``
-(stealth) simply never starts it.
+(stealth) simply never starts it.  Endpoint table:
+docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -16,6 +20,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from znicz_tpu import observe
 from znicz_tpu.core.logger import Logger
 
 
@@ -106,6 +111,11 @@ class WebStatus(Logger):
                     section[name] = {"error": repr(exc)}  # must not kill
             if section:                                   # the dashboard
                 doc[key] = section
+        # the shared telemetry plane rides along under its own top-level
+        # key — "metrics" collides with none of the per-plane sections
+        # above (workflows/serving/health/pipeline), pinned by
+        # tests/test_observe.py
+        doc["metrics"] = observe.REGISTRY.snapshot()
         return doc
 
     # -- server -------------------------------------------------------------
@@ -119,6 +129,16 @@ class WebStatus(Logger):
             def do_GET(self):
                 if self.path.startswith("/status.json"):
                     body = json.dumps(status.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    # Prometheus text exposition of the process-global
+                    # registry — the scrape target
+                    body = observe.REGISTRY.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/trace.json"):
+                    # Chrome-trace dump of the tracer ring (Perfetto)
+                    body = json.dumps(
+                        observe.TRACER.export_dict()).encode()
                     ctype = "application/json"
                 else:
                     rows = "".join(
