@@ -733,30 +733,39 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
     Workflow.run loop (CPU by design — it measures the instrumentation
     machinery, not the chip).  Runs the same seeded mnist_fc-shaped
     workflow with probes+tracer enabled vs ``observe.set_enabled(False)``
-    (the bare pre-ISSUE-5 walk).
+    (the bare pre-ISSUE-5 walk).  ISSUE 6 raised the instrumented arm's
+    load: it now also carries an attached watchtower (step-boundary
+    registry sampling + the full five-rule SLO catalogue) so the <2%
+    bound covers sampler + rule engine, not just probes + tracer.
 
     Protocol, forced by this box's load profile: scheduler theft on the
     shared sandbox swings individual runs ±10-40% (sampled runs sit at
     ~24k sps with sporadic dips to ~14k), and theft only ever SLOWS a
     run down — so per-run throughput is a one-sided underestimate of
     the machine's capability.  The scenario interleaves many short
-    bare/inst runs, alternates which arm goes first to cancel order
-    bias, and compares the arms at their best-of-N (max) throughput:
-    with 20 samples per arm at least one run per arm lands nearly
-    clean, so max converges to each arm's true speed while percentile
-    statistics still straddle the dip population (p75 measured anywhere
-    from -0.5% to +7.3% overhead across identical reruns; best-of-N
-    held inside ±0.6%).  The per-pair median ratio and the raw ratio
-    spread ride along as diagnostics.  The line lands first; the <2%
-    overhead contract and the bit-exact metric-history contract are
-    ASSERTED after it flushes, so a violation still records the
-    measurement but fails the scenario loudly (nonzero child exit)."""
+    bare/inst runs and alternates which arm goes first to cancel order
+    bias.  The r05-era protocol compared the arms at their best-of-N
+    (max) throughput; by ISSUE 6 the theft profile had worsened to the
+    point where individual runs swing 2x+ and the two arms' maxima land
+    on DIFFERENT theft luck (the best-of-N overhead measured -9.6%,
+    +3.3%, +8.6% and +11.5% across identical reruns while the median
+    flipped sign) — max no longer converges.  The asserted estimator is
+    now the QUIETEST-QUARTILE pair median: a pair whose two adjacent
+    runs were BOTH fast had theft touch neither arm, so its
+    instrumented/bare ratio is the trustworthy one — rank pairs by
+    combined runtime, keep the quietest quarter (>= 3 pairs), take the
+    median ratio.  Best-of-N and the all-pair median ride along as
+    diagnostics.  The line lands first; the <2% overhead contract and
+    the bit-exact metric-history contract are ASSERTED after it
+    flushes, so a violation still records the measurement but fails the
+    scenario loudly (nonzero child exit)."""
     import statistics
     import time as _time
 
     from znicz_tpu import observe
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.observe import watchtower as _wt
     from znicz_tpu.standard_workflow import StandardWorkflow
 
     layers = [
@@ -778,6 +787,21 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
             loader_name="synthetic_classifier", loader_config=loader_cfg,
             decision_config={"max_epochs": epochs})
         w.initialize(device=TPUDevice())
+        if enabled:
+            # ISSUE 6: the instrumented arm pays for the whole plane —
+            # step-boundary sampling + the full rule catalogue evaluated
+            # on every sample.  Occasional trips (recompile_storm sees
+            # the 40 re-initializing runs sharing one registry as a
+            # storm) are part of the measured load; trips never touch
+            # the metric history, so bit_exact still must hold.
+            tower = _wt.Watchtower()
+            for make_rule in (_wt.step_latency_regression,
+                              _wt.serve_queue_saturation,
+                              _wt.nan_guard_trip_rate,
+                              _wt.recompile_storm,
+                              _wt.pipeline_consumer_starvation):
+                tower.add_rule(make_rule())
+            tower.attach(w)
         t0 = _time.perf_counter()
         w.run()
         dt = _time.perf_counter() - t0
@@ -804,10 +828,19 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
         observe.set_enabled(True)
     bare_sps = max(bare)
     inst_sps = max(inst)
-    overhead_pct = (1.0 - inst_sps / bare_sps) * 100.0
+    best_of_n_pct = (1.0 - inst_sps / bare_sps) * 100.0
+    # quietest-quartile estimator (see docstring): rank pairs by the
+    # pair's combined wall time (1/sps + 1/sps), keep the least-stolen
+    # quarter, judge the median instrumented/bare ratio there
+    by_quiet = sorted(zip((1.0 / b + 1.0 / s
+                           for b, s in zip(bare, inst)), ratios))
+    quiet = [r for _, r in by_quiet[:max(3, pairs // 4)]]
+    overhead_pct = (1.0 - statistics.median(quiet)) * 100.0
     _emit("metrics_overhead_instrumented_samples_per_sec", inst_sps,
           cpu=True, bare_samples_per_sec=round(bare_sps, 1),
           overhead_pct=round(overhead_pct, 3),
+          quiet_pairs=len(quiet),
+          best_of_n_overhead_pct=round(best_of_n_pct, 3),
           median_overhead_pct=round(
               (1.0 - statistics.median(ratios)) * 100.0, 3),
           bit_exact=inst_hist == bare_hist, epochs=epochs, pairs=pairs,

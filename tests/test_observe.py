@@ -480,7 +480,7 @@ def test_web_status_snapshot_merges_all_blocks_without_collisions():
     finally:
         w.stop()
     assert set(doc) == {"workflows", "serving", "health", "pipeline",
-                        "metrics"}                 # disjoint, no collisions
+                        "metrics", "watchtower"}   # disjoint, no collisions
     assert doc["workflows"][0]["name"] == "ObserveMergeC"
     assert doc["serving"] == {"front": {"qps": 1.5}}
     assert doc["health"] == {"trainer": {"nan_trips": 0}}

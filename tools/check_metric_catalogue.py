@@ -1,0 +1,153 @@
+"""Static metric-catalogue check for tools/t1.sh (ISSUE 6): every
+`znicz_*` metric family used in `znicz_tpu/` source must appear in the
+docs/OBSERVABILITY.md catalogue, and every `znicz_*` name the catalogue
+lists must still exist in code — a renamed metric that leaves a stale
+dashboard row, or a new one nobody documented, fails tier-1 loudly.
+
+"Used in code" is collected two ways, both from the AST (docstrings
+and comments don't count):
+
+- declarations: the first string argument of a `counter(` / `gauge(` /
+  `histogram(` call;
+- references: any other string literal starting with `znicz_` — SLO
+  rule targets like `znicz_workflow_step_seconds_p95` or
+  `'znicz_resilience_events_total{kind="nan_guard"}'`.
+
+Derived flat-key suffixes (`_count`, `_sum`, `_bucket`, `_p50`, `_p95`,
+`_p99` — what `snapshot_flat()` appends to a histogram family) and
+`{label="..."}` filters are normalized away on BOTH sides before
+comparing, so the catalogue documents families, not every derived key.
+
+Exit 0 when the catalogue and the code agree; otherwise print one
+`check_metric_catalogue:`-prefixed line per discrepancy and exit 1.
+"""
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "znicz_tpu")
+CATALOGUE = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+#: snapshot_flat()-derived suffixes a reference may carry on top of the
+#: declared family name
+DERIVED_SUFFIXES = ("_count", "_sum", "_bucket", "_p50", "_p95", "_p99")
+
+#: znicz_-prefixed literals that are NOT metric families (module paths,
+#: logger names); the package itself is znicz_tpu so one prefix covers
+#: every module-ish string
+NON_METRIC_PREFIXES = ("znicz_tpu",)
+
+#: exact non-metric literals: __main__.py's importlib module name for
+#: user workflow files
+NON_METRIC_NAMES = {"znicz_workflow"}
+
+_NAME_RE = re.compile(r"^znicz_[a-z0-9_]+$")
+_DOC_NAME_RE = re.compile(r"`(znicz_[a-z0-9_{}=\",. ]*?)`")
+
+_DECL_FUNCS = {"counter", "gauge", "histogram"}
+
+
+def normalize(name: str) -> str:
+    """Family name for one code/docs reference: strip a label filter
+    and at most one derived suffix."""
+    name = name.partition("{")[0].strip()
+    for suffix in DERIVED_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if base != "znicz":
+                return base
+    return name
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _docstring_nodes(tree: ast.AST) -> set:
+    """ids of the Constant nodes that are docstrings."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def collect_code_families() -> dict:
+    """``{family: first 'path:line' seen}`` for every znicz_ metric
+    name used in znicz_tpu/ source."""
+    families: dict = {}
+    for dirpath, dirnames, filenames in os.walk(PACKAGE):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            docstrings = _docstring_nodes(tree)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Constant) or \
+                        not isinstance(node.value, str):
+                    continue
+                if id(node) in docstrings:
+                    continue
+                name = normalize(node.value)
+                if not _NAME_RE.match(name) or name in NON_METRIC_NAMES:
+                    continue
+                if any(name == p or name.startswith(p + "_")
+                       for p in NON_METRIC_PREFIXES):
+                    continue
+                where = f"{os.path.relpath(path, REPO)}:{node.lineno}"
+                families.setdefault(name, where)
+    return families
+
+
+def collect_doc_families() -> dict:
+    """``{family: line number}`` for every backticked znicz_ name in
+    the catalogue doc."""
+    families: dict = {}
+    with open(CATALOGUE, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            for raw in _DOC_NAME_RE.findall(line):
+                name = normalize(raw)
+                if _NAME_RE.match(name) and \
+                        not name.startswith("znicz_tpu"):
+                    families.setdefault(name, lineno)
+    return families
+
+
+def main() -> int:
+    code = collect_code_families()
+    docs = collect_doc_families()
+    rc = 0
+    for name in sorted(set(code) - set(docs)):
+        print(f"check_metric_catalogue: {name} (used at {code[name]}) "
+              f"is MISSING from docs/OBSERVABILITY.md",
+              file=sys.stderr)
+        rc = 1
+    for name in sorted(set(docs) - set(code)):
+        print(f"check_metric_catalogue: {name} "
+              f"(docs/OBSERVABILITY.md:{docs[name]}) is documented but "
+              f"no longer used anywhere in znicz_tpu/", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"check_metric_catalogue: ok — {len(code)} metric "
+              f"families, catalogue in sync")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,11 @@
-"""Post-test scrape smoke for tools/t1.sh (ISSUE 5): boot a WebStatus,
-hit `/metrics` and `/trace.json` over real HTTP, and fail LOUDLY on a
-non-200 status, an unparseable body, or an empty registry/trace.  Kept
-jax-free (observe + web_status are stdlib-only) so the smoke costs
-milliseconds after a 10-minute tier-1 run.
+"""Post-test scrape smoke for tools/t1.sh (ISSUE 5 + 6): boot a
+WebStatus, hit `/metrics`, `/trace.json` and `/timeseries.json` over
+real HTTP, dump a flight artifact and round-trip it through
+`python -m znicz_tpu flight`, and fail LOUDLY on a non-200 status, an
+unparseable body, an empty registry/trace/ring, or a flight viewer
+that can't read its own recorder's output.  Kept jax-free (observe +
+web_status are stdlib-only) so the smoke costs milliseconds after a
+10-minute tier-1 run.
 
 Exit 0 on success; any failure prints one `metrics_smoke:`-prefixed
 line to stderr and exits 1.
@@ -10,7 +13,9 @@ line to stderr and exits 1.
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -31,6 +36,8 @@ def main() -> None:
     with observe.span("smoke.step", step=1):
         pass
     observe.instant("smoke.event")
+    # ... and one watchtower sample so /timeseries.json has a ring entry
+    observe.WATCHTOWER.observe_now()
 
     status = WebStatus(port=0)
     port = status.start()
@@ -58,12 +65,47 @@ def main() -> None:
         if not {"smoke.step", "smoke.event"} <= names:
             fail(f"trace ring is missing the smoke events "
                  f"(got {sorted(n for n in names if n)[:8]}...)")
+
+        # ISSUE 6: the watchtower's retained ring must actually serve
+        resp = urllib.request.urlopen(base + "/timeseries.json",
+                                      timeout=10)
+        if resp.status != 200:
+            fail(f"GET /timeseries.json -> {resp.status}")
+        ts_doc = json.load(resp)
+        if not ts_doc.get("samples"):
+            fail("GET /timeseries.json served an EMPTY ring (sample "
+                 "taken before the scrape is missing)")
+        replay = dict(ts_doc["base"])
+        for row in ts_doc["samples"]:
+            replay.update(row["delta"])
+        if replay.get("znicz_smoke_total") != 1:
+            fail("replaying /timeseries.json base+deltas did not "
+                 "reconstruct the smoke counter")
     finally:
         status.stop()
 
+    # ISSUE 6: a flight dump must round-trip through the CLI viewer
+    from znicz_tpu.observe import flight
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = flight.dump(dir=tmp, reason="t1_smoke")
+        try:
+            flight.load(path)            # raises ValueError on a bad schema
+        except ValueError as exc:
+            fail(f"flight.load() rejected its own dump: {exc}")
+        proc = subprocess.run(
+            [sys.executable, "-m", "znicz_tpu", "flight", path],
+            capture_output=True, text=True, timeout=60)
+        if proc.returncode != 0:
+            fail(f"`python -m znicz_tpu flight` exited "
+                 f"{proc.returncode}: {proc.stderr.strip()[:200]}")
+        if "t1_smoke" not in proc.stdout:
+            fail("flight viewer output is missing the dump reason")
+
     print(f"metrics_smoke: ok — {len(type_lines)} registry families, "
           f"{sum(1 for e in doc['traceEvents'] if e['ph'] != 'M')} "
-          f"trace events")
+          f"trace events, {len(ts_doc['samples'])} ring samples, "
+          f"flight round-trip ok")
 
 
 if __name__ == "__main__":

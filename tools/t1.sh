@@ -11,12 +11,20 @@ if ! python -c "import pytest" 2>/dev/null; then
     exit 2
 fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
-# ISSUE 5 smoke: the telemetry scrape surfaces must actually serve —
-# boot a WebStatus, hit /metrics + /trace.json, fail loudly on non-200
-# or an empty registry (jax-free, milliseconds)
+# ISSUE 5+6 smoke: the telemetry scrape surfaces must actually serve —
+# boot a WebStatus, hit /metrics + /trace.json + /timeseries.json, and
+# round-trip a flight artifact through `python -m znicz_tpu flight`
+# (jax-free, milliseconds)
 if ! timeout -k 5 60 python tools/metrics_smoke.py; then
     echo "tools/t1.sh: telemetry scrape smoke FAILED (see metrics_smoke" \
          "lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
+# ISSUE 6 static pass: every znicz_* metric family used in znicz_tpu/
+# must be in the docs/OBSERVABILITY.md catalogue, and vice versa
+if ! timeout -k 5 60 python tools/check_metric_catalogue.py; then
+    echo "tools/t1.sh: metric catalogue check FAILED (see" \
+         "check_metric_catalogue lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
 exit $rc

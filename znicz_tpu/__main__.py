@@ -6,6 +6,7 @@ Usage:
     python -m znicz_tpu forge {list,upload,fetch} ...
     python -m znicz_tpu serve <package.npz> [options]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
+    python -m znicz_tpu flight <flight_artifact.json> [--json]
 
 The workflow file must expose ``run(load, main)`` (every models/ sample
 does); config files are executed Python mutating the global ``root`` tree;
@@ -190,6 +191,13 @@ def main(argv=None) -> int:
         from znicz_tpu.serve.server import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "flight":
+        # flight-recorder post-mortem viewer: pretty-print one
+        # observe/flight.py artifact (spans around the crash, rule
+        # states, time-series digest, log tail)
+        from znicz_tpu.observe import flight
+
+        return flight.flight_main(argv[1:])
     if argv and argv[0] == "trace":
         # observability shorthand: run the workflow, export its span
         # timeline — `znicz_tpu trace out.json workflow.py [cfg ...]`

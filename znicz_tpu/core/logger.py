@@ -14,11 +14,18 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 
 
 _configured = False
 _jsonl_paths: set[str] = set()
+
+
+def jsonl_paths() -> list[str]:
+    """Paths with an attached JSONL sink (the flight recorder reads the
+    newest one's tail into crash artifacts)."""
+    return sorted(_jsonl_paths)
 
 #: the observability plane's point events log through this name, so a
 #: JSONL sink interleaves them with ordinary log records
@@ -28,10 +35,24 @@ EVENT_LOGGER = "znicz_tpu.events"
 class JsonlHandler(logging.FileHandler):
     """One JSON object per record: ``{"ts", "level", "logger", "msg"}``
     plus an ``"event"``/``"args"`` pair when the record carries a
-    structured observe event (see :func:`event_log`)."""
+    structured observe event (see :func:`event_log`).
 
-    def __init__(self, path: str) -> None:
+    ``max_bytes > 0`` bounds the sink with a keep-1 rollover: when the
+    next record would cross the limit, the live file is atomically
+    renamed to ``<path>.1`` (replacing the previous rollover) and a
+    fresh file starts — a long supervised run holds at most
+    ``2 * max_bytes`` of events on disk instead of growing without
+    limit."""
+
+    def __init__(self, path: str, max_bytes: int = 0) -> None:
+        self.max_bytes = int(max_bytes)
         super().__init__(path, mode="a", delay=True)
+
+    def _rollover(self) -> None:
+        if self.stream is not None:
+            self.stream.close()
+            self.stream = None
+        os.replace(self.baseFilename, self.baseFilename + ".1")
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
@@ -46,20 +67,27 @@ class JsonlHandler(logging.FileHandler):
             if event is not None:
                 doc["event"] = event
                 doc["args"] = getattr(record, "observe_args", None)
+            line = json.dumps(doc) + "\n"
             stream = self.stream or self._open()
             self.stream = stream
-            stream.write(json.dumps(doc) + "\n")
+            if self.max_bytes and stream.tell() and \
+                    stream.tell() + len(line) > self.max_bytes:
+                self._rollover()
+                stream = self.stream = self._open()
+            stream.write(line)
             stream.flush()
         except Exception:  # noqa: BLE001 — logging must never raise
             self.handleError(record)
 
 
 def configure(level: int = logging.INFO,
-              jsonl_path: str | None = None) -> None:
+              jsonl_path: str | None = None,
+              max_bytes: int = 0) -> None:
     """Idempotent logging setup.  The human console format installs
     once; each distinct ``jsonl_path`` additionally attaches ONE
     :class:`JsonlHandler` on the root logger (opt-in — the default
-    stays plain text)."""
+    stays plain text).  ``max_bytes`` bounds the sink via the handler's
+    keep-1 rollover (0 = unbounded, the historical behavior)."""
     global _configured
     if not _configured:
         logging.basicConfig(
@@ -69,7 +97,7 @@ def configure(level: int = logging.INFO,
         )
         _configured = True
     if jsonl_path and jsonl_path not in _jsonl_paths:
-        handler = JsonlHandler(jsonl_path)
+        handler = JsonlHandler(jsonl_path, max_bytes=max_bytes)
         handler.setLevel(level)
         logging.getLogger().addHandler(handler)
         # observe-plane events log at INFO on the dedicated events

@@ -48,6 +48,11 @@ class Workflow(Unit):
         #: .attach_prefetcher — stopped on crash, surfaced in
         #: timing_table's stall block
         self.pipelines: list = []
+        #: attached observe.watchtower.Watchtower instances: the run
+        #: loop calls their on_step() at every signal-delivery boundary
+        #: (count-strided sampling + SLO rule evaluation); empty list =
+        #: one falsy check per delivery
+        self.watchtowers: list = []
 
     # -- child management ---------------------------------------------------
     def add_unit(self, unit: Unit) -> None:
@@ -137,15 +142,26 @@ class Workflow(Unit):
         try:
             while queue:
                 source, target = queue.popleft()
-                # chaos hook: the resilience plane injects crashes/hangs
-                # here (site "workflow.step") so fault tests drive this
-                # real loop; with no plan installed this is a single
-                # global None check
-                fault_hook("workflow.step", workflow=self, unit=target)
-                self.signals_dispatched += 1
                 if observed:
                     t0 = perf()
-                    target._signal(source, queue)
+                    try:
+                        # chaos hook: the resilience plane injects
+                        # crashes/hangs here (site "workflow.step") so
+                        # fault tests drive this real loop; with no plan
+                        # installed this is a single global None check
+                        fault_hook("workflow.step", workflow=self,
+                                   unit=target)
+                        self.signals_dispatched += 1
+                        target._signal(source, queue)
+                    except BaseException:
+                        # the CRASHING delivery still lands on the
+                        # timeline, error-marked — a flight artifact's
+                        # post-mortem window needs the step that died,
+                        # not just the ones before it
+                        TRACER.complete("workflow.step", t0, perf() - t0,
+                                        {"unit": target.name,
+                                         "error": True})
+                        raise
                     dt = perf() - t0
                     probe.signal_dispatched(dt)
                     tname = target.name
@@ -161,7 +177,16 @@ class Workflow(Unit):
                     # below closes the final window
                     if not self.signals_dispatched % 32:
                         probe.check_recompiles()
+                    if self.watchtowers:
+                        # attached towers sample the registry + evaluate
+                        # SLO rules at the step boundary (count-strided
+                        # inside on_step, so chaos runs stay exact)
+                        for tower in self.watchtowers:
+                            tower.on_step()
                 else:
+                    fault_hook("workflow.step", workflow=self,
+                               unit=target)
+                    self.signals_dispatched += 1
                     target._signal(source, queue)
                 if self.end_point.reached:
                     break

@@ -1,0 +1,293 @@
+"""Flight recorder — self-contained crash post-mortems (ISSUE 6
+tentpole, part 3).
+
+When a process dies, the telemetry that explains WHY dies with it: the
+tracer ring, the watchtower's time series and the registry are all
+in-memory.  ``dump()`` freezes them into ONE atomically-written
+``flight_<ts>_<reason>.json`` artifact:
+
+- the newest N trace-ring events (``workflow.step`` spans around the
+  crash — the failing delivery is recorded with ``error: true`` by the
+  run loop — plus every ``resilience.*`` / ``compile.*`` /
+  ``watchtower.trip`` instant);
+- the last K time-series samples from the global watchtower ring (a
+  fresh sample is taken at dump time, so even a never-sampled process
+  records its state at the moment of failure);
+- the full registry snapshot, a config/mesh fingerprint, and the tail
+  of the JSONL log sink when one is configured.
+
+Triggers: explicit ``dump()``; the supervisor dumps into its snapshot
+directory before every restore-and-resume (and on budget exhaustion) so
+the post-mortem survives the process; ``auto_dump()`` fires on injected
+faults, NaN-guard trips and watchtower rule trips but is a no-op until
+``configure(dir=...)`` opts in (chaos tests inject thousands of faults —
+they must not spray artifacts), and is rate-limited to one artifact per
+``min_interval_s``.
+
+``python -m znicz_tpu flight <artifact.json>`` pretty-prints one.
+
+Everything here is stdlib — a flight can be dumped (and read) without
+jax in the process; the mesh fingerprint is captured only when jax is
+ALREADY imported.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+from typing import Optional
+
+from znicz_tpu.core import logger as _logger
+from znicz_tpu.observe import registry as _reg
+from znicz_tpu.observe import trace as _trace
+from znicz_tpu.observe import watchtower as _watchtower
+
+#: artifact schema identifier — pinned by tests/test_watchtower.py
+SCHEMA = "znicz_tpu.flight/1"
+
+#: auto-dump configuration (process-global, mirrors the plane's other
+#: singletons); ``dir=None`` keeps auto_dump a no-op
+_config = {"dir": None, "last_spans": 256, "last_samples": 120,
+           "log_lines": 200, "min_interval_s": 1.0}
+_last_auto_dump = 0.0
+
+
+def configure(dir: Optional[str] = None, last_spans: int = 256,
+              last_samples: int = 120, log_lines: int = 200,
+              min_interval_s: float = 1.0) -> None:
+    """Opt in to automatic dumps: artifacts land in ``dir`` on every
+    injected fault / NaN-guard trip / watchtower rule trip, at most one
+    per ``min_interval_s``.  ``configure()`` with no dir disables."""
+    _config.update(dir=dir, last_spans=int(last_spans),
+                   last_samples=int(last_samples),
+                   log_lines=int(log_lines),
+                   min_interval_s=float(min_interval_s))
+
+
+def configured() -> bool:
+    return _config["dir"] is not None
+
+
+def _jsonable(value):
+    """Best-effort JSON coercion for config trees (Tune leaves, numpy
+    scalars, tuples) — a fingerprint must never fail a dump."""
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+def _config_fingerprint() -> dict:
+    """The active config tree + device/mesh shape — enough to answer
+    "what was this process actually running" from the artifact alone."""
+    out: dict = {"argv": list(sys.argv)}
+    try:
+        from znicz_tpu.core.config import root
+
+        out["root"] = _jsonable(root.as_dict())
+    except Exception:  # noqa: BLE001 — fingerprint is best-effort
+        out["root"] = None
+    jax = sys.modules.get("jax")   # fingerprint only an ALREADY-imported
+    if jax is not None:            # jax — a dump must never boot a backend
+        try:
+            devices = jax.devices()
+            out["mesh"] = {"platform": devices[0].platform,
+                           "device_kind": getattr(devices[0],
+                                                  "device_kind", ""),
+                           "device_count": len(devices),
+                           "process_index": getattr(
+                               jax, "process_index", lambda: 0)()}
+        except Exception:  # noqa: BLE001
+            out["mesh"] = None
+    else:
+        out["mesh"] = None
+    return out
+
+
+def _log_tail(max_lines: int) -> list:
+    """Tail of the newest configured JSONL log sink ([] without one)."""
+    paths = [p for p in _logger.jsonl_paths() if os.path.isfile(p)]
+    if not paths:
+        return []
+    newest = max(paths, key=os.path.getmtime)
+    try:
+        with open(newest, "rb") as f:
+            # read at most ~256 KiB off the end — log files rotate but
+            # a dump must stay O(artifact), not O(run length)
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 262144))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return []
+    return lines[-max_lines:]
+
+
+def build_artifact(reason: str, extra: Optional[dict] = None,
+                   last_spans: Optional[int] = None,
+                   last_samples: Optional[int] = None) -> dict:
+    """Assemble (but do not write) one flight document."""
+    n_spans = last_spans if last_spans is not None else \
+        _config["last_spans"]
+    n_samples = last_samples if last_samples is not None else \
+        _config["last_samples"]
+    # freeze the state AT the failure: one fresh ring sample guarantees
+    # >= 1 time-series sample even in a process that never attached the
+    # watchtower (flight_sample bypasses the observe master switch — a
+    # post-mortem wants the numbers regardless — and holds the tower's
+    # eval lock so a dump from another thread cannot race a concurrent
+    # rule evaluation)
+    tower = _watchtower.WATCHTOWER
+    tower.flight_sample()
+    ts_doc = tower.ring.to_dict(last_n=n_samples)
+    ts_doc["summary"] = tower.ring.summary()
+    ts_doc["rules"] = [r.snapshot() for r in tower.rules]
+    now = time.time()
+    return {
+        "schema": SCHEMA,
+        "reason": str(reason),
+        "ts": round(now, 6),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(now)),
+        "host": platform.node(),
+        "pid": os.getpid(),
+        "extra": _jsonable(extra or {}),
+        "spans": _trace.TRACER.tail(n_spans),
+        "timeseries": ts_doc,
+        "metrics": _reg.REGISTRY.snapshot(),
+        "config": _config_fingerprint(),
+        "log_tail": _log_tail(_config["log_lines"]),
+    }
+
+
+def dump(dir: Optional[str] = None, reason: str = "manual",
+         extra: Optional[dict] = None, last_spans: Optional[int] = None,
+         last_samples: Optional[int] = None) -> str:
+    """Write one flight artifact atomically (tmp + fsync + rename) into
+    ``dir`` (default: the configured auto-dump dir, else CWD); returns
+    the artifact path."""
+    target_dir = dir or _config["dir"] or "."
+    os.makedirs(target_dir, exist_ok=True)
+    doc = build_artifact(reason, extra, last_spans, last_samples)
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.localtime(doc["ts"]))
+    micros = int((doc["ts"] % 1) * 1e6)
+    slug = "".join(c if c.isalnum() else "_" for c in doc["reason"])[:32]
+    path = os.path.join(target_dir,
+                        f"flight_{stamp}_{micros:06d}_{slug}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)     # a crash mid-dump leaves no torn artifact
+    return path
+
+
+def auto_dump(reason: str, **ctx) -> Optional[str]:
+    """Event-triggered dump (fault fired, NaN-guard trip, rule trip):
+    no-op until :func:`configure` set a directory, rate-limited, and
+    NEVER raises — the failure path must not fail harder because the
+    recorder did."""
+    global _last_auto_dump
+    if _config["dir"] is None:
+        return None
+    now = time.monotonic()
+    if now - _last_auto_dump < _config["min_interval_s"]:
+        return None
+    try:
+        path = dump(reason=reason, extra=ctx)
+    except Exception:  # noqa: BLE001
+        return None
+    # stamp AFTER a successful write: a failed attempt (disk full,
+    # unwritable dir) must not arm the rate limiter and suppress the
+    # next real artifact
+    _last_auto_dump = now
+    return path
+
+
+def load(path: str) -> dict:
+    """Read + schema-check one artifact."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: not a flight artifact "
+                         f"(schema={doc.get('schema')!r}, "
+                         f"expected {SCHEMA!r})")
+    return doc
+
+
+# -- CLI (python -m znicz_tpu flight <artifact.json>) ------------------------
+
+def print_flight(doc: dict, out=None, span_rows: int = 20) -> None:
+    """Human rendering of one artifact: reason, the newest spans and
+    instants, rule states, the time-series digest, and the log tail."""
+    out = out or sys.stdout
+    w = out.write
+    w(f"flight: {doc['reason']} at {doc['iso']} "
+      f"(host {doc['host']}, pid {doc['pid']})\n")
+    if doc.get("extra"):
+        w(f"  extra: {json.dumps(doc['extra'])}\n")
+    cfg = doc.get("config") or {}
+    if cfg.get("mesh"):
+        m = cfg["mesh"]
+        w(f"  mesh: {m.get('device_count')}x {m.get('platform')} "
+          f"({m.get('device_kind')})\n")
+    spans = doc.get("spans", [])
+    w(f"\nspans: {len(spans)} ring events (newest last)\n")
+    for ev in spans[-span_rows:]:
+        args = f"  {json.dumps(ev['args'])}" if ev.get("args") else ""
+        if ev.get("ph") == "X":
+            w(f"  {ev['ts']:>14.1f}us  {ev.get('dur', 0):>11.1f}us  "
+              f"{ev['name']}{args}\n")
+        else:
+            w(f"  {ev['ts']:>14.1f}us  {'instant':>13}  "
+              f"{ev['name']}{args}\n")
+    ts = doc.get("timeseries", {})
+    samples = ts.get("samples", [])
+    w(f"\ntimeseries: {len(samples)} samples")
+    if len(samples) >= 2:
+        w(f" over {samples[-1]['ts'] - samples[0]['ts']:.1f}s")
+    w("\n")
+    for rule in ts.get("rules", []):
+        w(f"  rule {rule['name']}: trips={rule['trips']} "
+          f"last={rule['last_value']}\n")
+    summary = ts.get("summary", {})
+    for key, row in sorted(summary.items()):
+        rate = (f"  rate={row['rate_per_s']:g}/s"
+                if "rate_per_s" in row else "")
+        w(f"  {key}: last={row['last']:g} min={row['min']:g} "
+          f"mean={row['mean']:g} max={row['max']:g}{rate}\n")
+    w(f"\nmetrics: {len(doc.get('metrics', {}))} registry families\n")
+    tail = doc.get("log_tail", [])
+    if tail:
+        w(f"\nlog tail ({len(tail)} lines):\n")
+        for line in tail[-20:]:
+            w(f"  {line}\n")
+
+
+def flight_main(argv) -> int:
+    """``znicz_tpu flight <artifact.json> [--json]`` entry point."""
+    args = [a for a in argv if not a.startswith("-")]
+    if len(args) != 1:
+        print("usage: znicz_tpu flight <flight_artifact.json> [--json]",
+              file=sys.stderr)
+        return 2
+    try:
+        doc = load(args[0])
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"flight: {exc}", file=sys.stderr)
+        return 1
+    if "--json" in argv:
+        json.dump(doc, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        print_flight(doc)
+    return 0

@@ -35,6 +35,7 @@ path and how determinism tests pin "instrumentation off == seed path".
 
 from __future__ import annotations
 
+import time
 import weakref
 from typing import Optional
 
@@ -212,6 +213,64 @@ def check_recompiles() -> int:
             # so the shrink is not later mistaken for absence of growth
             entry[1] = total
     return new
+
+
+# -- cold-compile timing -----------------------------------------------------
+
+_COMPILE_SECONDS = _reg.histogram(
+    "znicz_compile_seconds",
+    "cold-path XLA compile wall time: first call of a wrapped jitted "
+    "program, or a serve-engine bucket materializing",
+    labelnames=("fn",))
+
+
+def compile_observed(label: str, dt_s: float, **args) -> None:
+    """One cold compile (+ first execution) took ``dt_s`` wall seconds:
+    histogram observation plus a ``compile.cold`` complete-span on the
+    trace timeline, so the ROADMAP compile-latency work lands with its
+    baseline already recorded."""
+    if not _enabled:
+        return
+    _COMPILE_SECONDS.labels(fn=label).observe(dt_s)
+    _trace.TRACER.complete("compile.cold", time.perf_counter() - dt_s,
+                           dt_s, fn=label, **args)
+
+
+class _CompileTimed:
+    """Thin wrapper over a jitted callable: the FIRST invocation — the
+    trace+compile+run cold path — is timed into ``znicz_compile_seconds
+    {fn=label}``; every later call is one attribute check of passthrough.
+    ``_cache_size`` delegates so :func:`watch_compiles` keeps polling the
+    real compile cache through the wrapper."""
+
+    __slots__ = ("_fn", "_label", "_cold", "__weakref__")
+
+    def __init__(self, fn, label: str) -> None:
+        self._fn = fn
+        self._label = label
+        self._cold = True
+
+    def _cache_size(self) -> int:
+        size = getattr(self._fn, "_cache_size", None)
+        return int(size()) if size is not None else 0
+
+    def __call__(self, *args, **kw):
+        if not self._cold:
+            return self._fn(*args, **kw)
+        self._cold = False
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kw)
+        compile_observed(self._label, time.perf_counter() - t0)
+        return out
+
+
+def time_compiles(label: str, fn):
+    """Wrap ``fn`` (a jitted program) so its first call lands in the
+    compile-time histogram; ``None`` passes through for optional
+    programs."""
+    if fn is None:
+        return None
+    return _CompileTimed(fn, label)
 
 
 # -- pipeline plane ----------------------------------------------------------

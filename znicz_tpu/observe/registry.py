@@ -60,6 +60,39 @@ def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
     return "{" + inner + "}"
 
 
+def quantile_from_buckets(edges: Sequence[float], counts: Sequence[int],
+                          q: float,
+                          overflow_hi: Optional[float] = None) -> float:
+    """THE histogram quantile estimator (ISSUE 6 satellite): linear
+    interpolation inside the winning bucket, the Prometheus
+    ``histogram_quantile`` convention — accuracy bounded by bucket
+    width, no per-observation sample retention.  ``counts`` are
+    per-bucket (NOT cumulative) with the ``+Inf`` overflow last, so
+    ``len(counts) == len(edges) + 1``; ``q`` in [0, 1].  A quantile
+    landing in the overflow bucket interpolates toward ``overflow_hi``
+    (callers pass ``max(last_edge, mean)`` — the serving plane's
+    long-standing convention) or clamps to the last edge.  Shared by
+    :meth:`_Child.quantile` and ``serve/metrics.py::LatencyHistogram``
+    instead of two private percentile codes."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    seen = 0
+    for i, count in enumerate(counts):
+        if count == 0:
+            continue
+        if seen + count >= rank:
+            lo = edges[i - 1] if i > 0 else 0.0
+            if i < len(edges):
+                hi = edges[i]
+            else:
+                hi = overflow_hi if overflow_hi is not None else edges[-1]
+            return lo + (hi - lo) * (rank - seen) / count
+        seen += count
+    return edges[-1]
+
+
 class _Child:
     """One (family, labelset) time series.  All mutation goes through the
     owning registry's lock (passed in) — a single shared lock keeps the
@@ -131,12 +164,31 @@ class _Child:
                                  else f"{self._edges[i]:g}"): c
                                 for i, c in enumerate(self.counts)}}
 
+    def raw(self) -> tuple:
+        """``(count, sum, per-bucket counts)`` in ONE lock round-trip —
+        the ``snapshot_flat`` hot path (the watchtower samples it on
+        every stride; three separate ``quantile()`` calls would pay
+        three lock+copy rounds)."""
+        with self._lock:
+            return self.count, self.sum, list(self.counts)
+
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile (0 when empty) via the shared
+        :func:`quantile_from_buckets`; the overflow bucket interpolates
+        toward ``max(last_edge, mean)``."""
+        total, total_sum, counts = self.raw()
+        if total == 0:
+            return 0.0
+        return quantile_from_buckets(
+            self._edges, counts, q,
+            overflow_hi=max(self._edges[-1], total_sum / total))
+
 
 class _Family:
     """A named metric family: type + help + label schema + children."""
 
     __slots__ = ("name", "type", "help", "labelnames", "buckets",
-                 "_children", "_lock")
+                 "_children", "_lock", "_flat_keys")
 
     def __init__(self, name: str, mtype: str, help_: str,
                  labelnames: tuple, lock: threading.Lock,
@@ -148,8 +200,37 @@ class _Family:
         self.buckets = buckets
         self._children: dict[tuple, _Child] = {}
         self._lock = lock
+        self._flat_keys: dict[tuple, object] = {}
         if not labelnames:
             self._children[()] = _Child(lock, buckets)
+
+    def _flat_key(self, key: tuple):
+        """Memoized flat-snapshot key strings for one labelset: key
+        formatting dominates ``snapshot_flat`` once the watchtower
+        samples it every stride, and the strings never change (label
+        schema and bucket edges are both declaration-frozen).  Scalars
+        cache the single ``name{labels}`` string; histograms cache
+        ``(count_key, sum_key, ((quantile_key, q), ...),
+        (bucket_key, ...))``."""
+        entry = self._flat_keys.get(key)
+        if entry is not None:
+            return entry
+        ls = _label_str(self.labelnames, key)
+        if self.type == "histogram":
+            names = self.labelnames + ("le",)
+            edge_strs = [f"{e:g}" for e in self.buckets] + ["+Inf"]
+            entry = (
+                f"{self.name}_count{ls}", f"{self.name}_sum{ls}",
+                tuple((f"{self.name}_{tag}{ls}", q)
+                      for q, tag in ((0.5, "p50"), (0.95, "p95"),
+                                     (0.99, "p99"))),
+                tuple(f"{self.name}_bucket"
+                      f"{_label_str(names, key + (e,))}"
+                      for e in edge_strs))
+        else:
+            entry = f"{self.name}{ls}"
+        self._flat_keys[key] = entry       # idempotent; GIL-atomic
+        return entry
 
     def labels(self, **kv) -> _Child:
         if set(kv) != set(self.labelnames):
@@ -268,28 +349,51 @@ class Registry:
                              "values": values}
         return out
 
-    def snapshot_flat(self, skip_zero: bool = True) -> dict:
+    def snapshot_flat(self, skip_zero: bool = True,
+                      buckets: bool = False) -> dict:
         """Compact ``name{labels} -> number`` dict (histograms contribute
-        ``_count`` and ``_sum``) — the per-scenario snapshot bench.py
-        attaches to its JSON result lines.  ``skip_zero`` drops
-        never-touched series so artifact lines stay small."""
+        ``_count`` / ``_sum`` plus estimated ``_p50`` / ``_p95`` /
+        ``_p99`` so SLO rules and time series can target latency
+        quantiles directly) — the per-scenario snapshot bench.py
+        attaches to its JSON result lines and the watchtower ring
+        samples.  ``skip_zero`` drops never-touched series so artifact
+        lines stay small.  ``buckets`` additionally emits each
+        histogram's cumulative ``name_bucket{...,le="..."}`` counts
+        (Prometheus convention) — the watchtower samples with it so
+        windowed quantiles can be computed over bucket-count deltas
+        (the lifetime ``_p95`` estimate damps mid-run regressions)."""
         with self._lock:
             fams = list(self._families.values())
         out = {}
         for fam in fams:
             for key, child in fam.items():
-                ls = _label_str(fam.labelnames, key)
                 if fam.type == "histogram":
-                    h = child.hist_dict()
-                    if skip_zero and h["count"] == 0:
+                    count, total_sum, counts = child.raw()
+                    if skip_zero and count == 0:
                         continue
-                    out[f"{fam.name}_count{ls}"] = h["count"]
-                    out[f"{fam.name}_sum{ls}"] = round(h["sum"], 6)
+                    count_key, sum_key, q_keys, bucket_keys = \
+                        fam._flat_key(key)
+                    out[count_key] = count
+                    out[sum_key] = round(total_sum, 6)
+                    if count:
+                        hi = max(child._edges[-1], total_sum / count)
+                        for qk, q in q_keys:
+                            out[qk] = round(quantile_from_buckets(
+                                child._edges, counts, q,
+                                overflow_hi=hi), 6)
+                    else:
+                        for qk, _ in q_keys:
+                            out[qk] = 0.0
+                    if buckets:
+                        acc = 0
+                        for bk, c in zip(bucket_keys, counts):
+                            acc += c
+                            out[bk] = acc
                 else:
                     v = child.get()
                     if skip_zero and v == 0.0:
                         continue
-                    out[f"{fam.name}{ls}"] = round(v, 6)
+                    out[fam._flat_key(key)] = round(v, 6)
         return out
 
     def render_prometheus(self) -> str:

@@ -146,6 +146,26 @@ class Tracer:
         return len(self._events)
 
     # -- export --------------------------------------------------------------
+    @staticmethod
+    def _format_event(event: tuple, pid: int) -> dict:
+        ph, name, ts, dur, tid, args = event
+        ev = {"ph": ph, "pid": pid, "tid": tid, "name": name,
+              "ts": round(ts, 3), "cat": name.split(".", 1)[0]}
+        if ph == "X":
+            ev["dur"] = round(dur, 3)
+        else:
+            ev["s"] = "t"              # instant scoped to its thread
+        if args:
+            ev["args"] = args
+        return ev
+
+    def tail(self, n: int) -> list:
+        """Newest ``n`` ring events as Chrome-trace dicts (no metadata
+        rows) — the flight recorder's span window around a crash."""
+        events = list(self._events)    # atomic snapshot of the ring
+        pid = os.getpid()
+        return [self._format_event(e, pid) for e in events[-n:]]
+
     def export_dict(self) -> dict:
         """Chrome trace JSON document (``{"traceEvents": [...]}``)."""
         pid = os.getpid()
@@ -155,16 +175,8 @@ class Tracer:
                 "args": {"name": "znicz_tpu"}}]
         for t in threading.enumerate():
             tids[t.ident] = t.name
-        for ph, name, ts, dur, tid, args in events:
-            ev = {"ph": ph, "pid": pid, "tid": tid, "name": name,
-                  "ts": round(ts, 3), "cat": name.split(".", 1)[0]}
-            if ph == "X":
-                ev["dur"] = round(dur, 3)
-            else:
-                ev["s"] = "t"          # instant scoped to its thread
-            if args:
-                ev["args"] = args
-            out.append(ev)
+        for event in events:
+            out.append(self._format_event(event, pid))
         for ident, tname in tids.items():
             out.append({"ph": "M", "pid": pid, "tid": ident,
                         "name": "thread_name", "args": {"name": tname}})

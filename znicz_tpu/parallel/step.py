@@ -778,21 +778,34 @@ class FusedTrainStep(Unit):
                     f"unit(s) {offenders} would silently coarsen to "
                     f"per-pass schedules; use by_epoch=True or disable "
                     f"scan_epoch")
-        # telemetry plane: donate the compiled programs to the recompile
-        # probe — the workflow run loop polls their compile-cache sizes,
-        # so an unexpected mid-run recompile lands as a counter increment
-        # plus an instant event on the step timeline.  Keyed per
-        # INSTANCE (two live steps keep separate watches; the probe
-        # holds weakrefs, so a dropped step reaps its own entry) while
-        # the metric label stays the class name.
+        # telemetry plane: wrap every compiled program so its FIRST call
+        # (the trace+compile+run cold path) lands in the
+        # znicz_compile_seconds histogram with a compile.cold span —
+        # the ROADMAP compile-latency item's baseline — then donate the
+        # wrappers to the recompile probe, which polls the REAL
+        # compile-cache sizes through them, so an unexpected mid-run
+        # recompile lands as a counter increment plus an instant event
+        # on the step timeline.  Keyed per INSTANCE (two live steps keep
+        # separate watches; the probe holds weakrefs, so a dropped step
+        # reaps its own entry) while the metric label stays the class
+        # name.
         from znicz_tpu.observe import probe as _probe
+        label = type(self).__name__
+        for attr in ("_train_fn", "_eval_fn", "_grad_fn", "_apply_fn",
+                     "_train_fn_idx", "_eval_fn_idx", "_grad_fn_idx",
+                     "_scan_fn"):
+            fn = getattr(self, attr, None)
+            if fn is not None:
+                setattr(self, attr, _probe.time_compiles(label, fn))
+        self._scan_idx_fns = {k: _probe.time_compiles(label, fn)
+                              for k, fn in self._scan_idx_fns.items()}
         fns = [getattr(self, n, None) for n in
                ("_train_fn", "_eval_fn", "_grad_fn", "_apply_fn",
                 "_train_fn_idx", "_eval_fn_idx", "_grad_fn_idx",
                 "_scan_fn")] + list(self._scan_idx_fns.values())
         _probe.watch_compiles(f"{type(self).__name__}-{id(self):x}",
                               *(f for f in fns if f is not None),
-                              label=type(self).__name__)
+                              label=label)
         self.initialized = True
 
     def _pin_dataset(self) -> None:
@@ -902,7 +915,9 @@ class FusedTrainStep(Unit):
                        in_specs=(pspecs, rep, rep, sh, sh, sh),
                        out_specs=(pspecs, rep, rep))
         donate = (0, 1) if self.donate else ()
-        self._scan_fn = jax.jit(fn, donate_argnums=donate)
+        from znicz_tpu.observe import probe as _probe
+        self._scan_fn = _probe.time_compiles(
+            type(self).__name__, jax.jit(fn, donate_argnums=donate))
 
     def train_steps(self, xs, ys, masks):
         """Run ``xs.shape[0]`` training minibatches in ONE dispatch and

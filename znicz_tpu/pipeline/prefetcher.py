@@ -50,6 +50,7 @@ import numpy as np
 
 from znicz_tpu.observe import probe
 from znicz_tpu.observe import registry as _metrics
+from znicz_tpu.observe import trace as _trace
 from znicz_tpu.resilience.faults import fault_hook
 
 # shared-registry mirror of PipelineStats (ISSUE 5): the instance stats
@@ -269,6 +270,14 @@ class BatchPrefetcher:
                         _M_BARRIER.inc(barrier_dt)
         except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
             self._error = exc
+            # the error is parked until the consumer drains the queue —
+            # drop an instant NOW so a flight artifact dumped between
+            # the worker dying and the consumer noticing still carries
+            # the real failure point
+            if probe.enabled():
+                _trace.instant("pipeline.error",
+                               error=type(exc).__name__,
+                               batch=self.stats.produced)
 
     # -- consumer ------------------------------------------------------------
     def next_batch(self) -> StagedBatch:

@@ -55,6 +55,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from znicz_tpu.observe import flight as _flight
 from znicz_tpu.observe import probe as _probe
 
 
@@ -165,9 +166,13 @@ class FaultPlan:
             return
         # telemetry plane: every firing lands as a counter + an instant
         # event on the step timeline (emitted OUTSIDE the plan lock —
-        # the registry/tracer must never nest under it)
+        # the registry/tracer must never nest under it); with the flight
+        # recorder configured, the firing also freezes a post-mortem
+        # artifact (no-op + rate-limited otherwise)
         _probe.resilience_event("fault", site=site, action=fault.action,
                                 hit=hit)
+        _flight.auto_dump("fault", site=site, action=fault.action,
+                          hit=hit)
         if fault.action == "crash":
             raise FaultInjected(f"injected crash at {site} hit {hit}")
         if fault.action == "oserror":

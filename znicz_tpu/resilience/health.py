@@ -41,6 +41,7 @@ import math
 import numpy as np
 
 from znicz_tpu.core.units import Unit
+from znicz_tpu.observe import flight as _flight
 from znicz_tpu.observe import probe as _probe
 
 
@@ -154,6 +155,11 @@ class HealthGuard(Unit):
         self.last_trip_run = self._runs
         _probe.resilience_event("nan_guard", action=self.mode,
                                 run=self._runs, trip=self.nan_trips)
+        # a NaN trip is exactly the "what led up to this" moment the
+        # flight recorder exists for (no-op unless flight.configure()
+        # opted in)
+        _flight.auto_dump("nan_guard", mode=self.mode, run=self._runs,
+                          trip=self.nan_trips)
         if self.mode == "skip":
             # the candidate may be the poison itself (captured after the
             # update this metric is now flagging) — drop it

@@ -36,6 +36,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.observe import flight as _flight
 from znicz_tpu.observe import probe as _probe
 from znicz_tpu.resilience import faults
 from znicz_tpu.snapshotter import restore_state, verify_snapshot
@@ -61,6 +62,11 @@ class SupervisorPolicy:
                    off; the workflow runs on the calling thread).
     hang_grace:    after interrupting injected hangs, how long to wait
                    for the worker thread to die before abandoning it.
+    flight_recorder: dump a flight artifact (observe/flight.py: span
+                   tail + time series + registry + log tail) into the
+                   snapshot directory before every restore-and-resume
+                   and on budget exhaustion, so the post-mortem
+                   survives the process.
     sleep:         injectable clock for tests.
     """
 
@@ -68,7 +74,7 @@ class SupervisorPolicy:
                  backoff_multiplier: float = 2.0, backoff_max: float = 5.0,
                  backoff_jitter: float = 0.25, seed: int = 0,
                  step_timeout: Optional[float] = None,
-                 hang_grace: float = 2.0,
+                 hang_grace: float = 2.0, flight_recorder: bool = True,
                  sleep: Callable[[float], None] = time.sleep) -> None:
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got "
@@ -80,6 +86,7 @@ class SupervisorPolicy:
         self.backoff_jitter = float(backoff_jitter)
         self.step_timeout = step_timeout
         self.hang_grace = float(hang_grace)
+        self.flight_recorder = bool(flight_recorder)
         self.sleep = sleep
         self._rng = np.random.default_rng(seed)
 
@@ -95,8 +102,9 @@ class SupervisorPolicy:
 
 class SupervisorReport:
     """What happened: restart count, snapshots resumed from, snapshots
-    rejected as invalid, hang events, the failures caught, and the final
-    workflow (its ``decision.metrics_history`` is the training record)."""
+    rejected as invalid, hang events, the failures caught, the flight
+    artifacts dumped per failure, and the final workflow (its
+    ``decision.metrics_history`` is the training record)."""
 
     def __init__(self) -> None:
         self.restarts = 0
@@ -104,6 +112,7 @@ class SupervisorReport:
         self.rejected_snapshots: list[str] = []
         self.hang_events = 0
         self.failures: list[str] = []
+        self.flights: list[str] = []
         self.workflow = None
 
     def as_dict(self) -> dict:
@@ -111,7 +120,8 @@ class SupervisorReport:
                 "resumed_from": list(self.resumed_from),
                 "rejected_snapshots": list(self.rejected_snapshots),
                 "hang_events": self.hang_events,
-                "failures": list(self.failures)}
+                "failures": list(self.failures),
+                "flights": list(self.flights)}
 
 
 _EPOCH_RE = re.compile(r"_(\d+)\.npz$")
@@ -259,8 +269,25 @@ def run_supervised(workflow_factory: Callable, snap_dir: str,
         # last step span of the crashed attempt and the first of the next
         _probe.resilience_event("restart", attempt=attempt,
                                 error=type(error).__name__)
+        exhausted = report.restarts > policy.max_restarts
+        if policy.flight_recorder:
+            # post-mortem BEFORE restore-and-resume (or the final
+            # raise): the next attempt overwrites in-memory telemetry,
+            # so this artifact is the only record of the crashed one.
+            # Recorder failures degrade to a warning — they must not
+            # consume another restart.
+            try:
+                report.flights.append(_flight.dump(
+                    dir=snap_dir,
+                    reason="exhausted" if exhausted else "restart",
+                    extra={"attempt": attempt, "restarts": report.restarts,
+                           "error": repr(error),
+                           "error_type": type(error).__name__}))
+            except Exception as flight_exc:  # noqa: BLE001
+                log.warning(f"supervisor: flight dump failed: "
+                            f"{flight_exc!r}")
         log.warning(f"supervisor: attempt {attempt} failed: {error!r}")
-        if report.restarts > policy.max_restarts:
+        if exhausted:
             raise SupervisorExhausted(
                 f"gave up after {report.restarts - 1} restarts "
                 f"({policy.max_restarts} allowed); failures: "

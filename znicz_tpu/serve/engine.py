@@ -20,6 +20,7 @@ Backends: ``utils.export.ExportedForward`` (jitted JAX), ``native.infer
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -145,16 +146,22 @@ class BatchEngine(Logger):
                 compiled = True
                 self.debug(f"compiling bucket {bucket} "
                            f"({self.compile_count}/{len(self.buckets)})")
+            t0 = time.perf_counter()
             y = np.asarray(self.model(x))
+            dt = time.perf_counter() - t0
             self.run_count += 1
             self.rows_served += n
         if compiled and observe.enabled():
             # shared telemetry plane: a bucket materializing after warmup
             # is the steady-state-recompile smell the serve bench asserts
-            # against — make it scrapeable and visible on the timeline
+            # against — make it scrapeable and visible on the timeline,
+            # and record how long the cold bucket cost (the compile-
+            # latency baseline, znicz_compile_seconds + compile.cold
+            # span)
             observe.counter("znicz_serve_engine_compiles_total",
                             "engine buckets compiled").inc()
             observe.instant("serve.compile", bucket=bucket)
+            observe.compile_observed("BatchEngine", dt, bucket=bucket)
         return y[:n]
 
     def stats(self) -> dict:

@@ -19,6 +19,7 @@ from collections import deque
 
 from znicz_tpu.observe import probe as _probe
 from znicz_tpu.observe import registry as _metrics
+from znicz_tpu.observe.registry import quantile_from_buckets
 
 #: Fixed latency bucket upper bounds in milliseconds.  Spanning 0.5 ms
 #: (in-process hits on a warm engine) to 8 s (drain under overload);
@@ -77,22 +78,17 @@ class LatencyHistogram:
         self.sum_ms += ms
 
     def percentile(self, p: float) -> float:
-        """Estimated ``p``-th percentile in milliseconds (0 when empty)."""
+        """Estimated ``p``-th percentile in milliseconds (0 when empty)
+        — delegates to the registry's shared
+        :func:`~znicz_tpu.observe.registry.quantile_from_buckets`
+        (ISSUE 6: one quantile estimator, not two private codes), with
+        this histogram's long-standing overflow convention (interpolate
+        toward ``max(last_edge, mean)``)."""
         if self.total == 0:
             return 0.0
-        rank = p / 100.0 * self.total
-        seen = 0
-        for i, count in enumerate(self.counts):
-            if count == 0:
-                continue
-            if seen + count >= rank:
-                lo = self.edges[i - 1] if i > 0 else 0.0
-                hi = self.edges[i] if i < len(self.edges) else \
-                    max(self.edges[-1], self.sum_ms / self.total)
-                frac = (rank - seen) / count
-                return lo + (hi - lo) * frac
-            seen += count
-        return self.edges[-1]
+        return quantile_from_buckets(
+            self.edges, self.counts, p / 100.0,
+            overflow_hi=max(self.edges[-1], self.sum_ms / self.total))
 
     def snapshot(self) -> dict:
         return {

@@ -4,10 +4,13 @@ The reference ran a tornado dashboard aggregating running workflows'
 progress over ZMQ (SURVEY.md §3.3 Web status row).  The rebuild is a
 minimal in-process HTTP endpoint on the TPU-VM host: ``/status.json``
 reports every registered workflow's name, epoch, metrics history and
-per-unit timing; ``/metrics`` serves the process-global telemetry
+per-unit timing (plus the watchtower's time-series digest under
+``"watchtower"``); ``/metrics`` serves the process-global telemetry
 registry in Prometheus text exposition format (scrapeable);
 ``/trace.json`` dumps the global tracer's ring buffer as Chrome-trace
-JSON (loads in Perfetto); ``/`` renders a plain HTML table.  Stdlib
+JSON (loads in Perfetto); ``/timeseries.json`` serves the watchtower's
+retained delta ring (observe/watchtower.py) so history is readable
+without an external scraper; ``/`` renders a plain HTML table.  Stdlib
 ``http.server`` on a daemon thread — zero dependencies, CLI ``-s``
 (stealth) simply never starts it.  Endpoint table:
 docs/OBSERVABILITY.md.
@@ -112,10 +115,11 @@ class WebStatus(Logger):
             if section:                                   # the dashboard
                 doc[key] = section
         # the shared telemetry plane rides along under its own top-level
-        # key — "metrics" collides with none of the per-plane sections
-        # above (workflows/serving/health/pipeline), pinned by
-        # tests/test_observe.py
+        # keys — "metrics"/"watchtower" collide with none of the
+        # per-plane sections above (workflows/serving/health/pipeline),
+        # pinned by tests/test_observe.py
         doc["metrics"] = observe.REGISTRY.snapshot()
+        doc["watchtower"] = observe.WATCHTOWER.snapshot()
         return doc
 
     # -- server -------------------------------------------------------------
@@ -139,6 +143,13 @@ class WebStatus(Logger):
                     # Chrome-trace dump of the tracer ring (Perfetto)
                     body = json.dumps(
                         observe.TRACER.export_dict()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/timeseries.json"):
+                    # the watchtower's retained delta ring: replay
+                    # base + samples in order to reconstruct every
+                    # metric's history (docs/OBSERVABILITY.md)
+                    body = json.dumps(
+                        observe.WATCHTOWER.timeseries_dict()).encode()
                     ctype = "application/json"
                 else:
                     rows = "".join(
