@@ -49,12 +49,17 @@ CPU_TIMEOUT = int(os.environ.get("BENCH_CPU_TIMEOUT", 300))
 
 
 def _enable_compile_cache():
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    import jax
+    # ISSUE 7: routed through the compile-latency plane so cache
+    # hits/misses land in znicz_compile_cache_{hits,misses}_total and
+    # every scenario line can report its compile-cost delta.  The env
+    # override ($ZNICZ_TPU_COMPILE_CACHE) wins over the repo-local dir —
+    # the compile_latency scenario uses that to point its probe children
+    # at a fresh directory.
+    import jax  # noqa: F401 — ensure() only configures once jax exists
+    from znicz_tpu import compilecache
 
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    os.environ.setdefault(compilecache.ENV_VAR, CACHE_DIR)
+    compilecache.configure(min_compile_time_s=0.0)
 
 
 @contextlib.contextmanager
@@ -197,6 +202,28 @@ def _prev_round_values() -> dict:
     return vals
 
 
+#: compile-cost baseline for per-line deltas (ISSUE 7): totals as of the
+#: previous _emit, so each scenario line carries ITS OWN compile bill
+_compile_base = None
+
+
+def _compile_totals():
+    """Lifetime compile-cost totals: summed ``znicz_compile_seconds``
+    (cold trace+compile+run wall time of wrapped programs and engine
+    buckets) plus the persistent-cache hit/miss counters."""
+    try:
+        from znicz_tpu.observe import REGISTRY, compile_cache_stats
+        snap = REGISTRY.snapshot_flat(skip_zero=False)
+        cold = sum(v for k, v in snap.items()
+                   if k.startswith("znicz_compile_seconds_sum"))
+        hits, misses = compile_cache_stats()
+        return {"cold_seconds": cold, "cache_hits": hits,
+                "cache_misses": misses}
+    except Exception as exc:  # noqa: BLE001 — telemetry must not cost
+        print(f"# compile totals unavailable: {exc!r}", file=sys.stderr)
+        return None
+
+
 def _emit(metric: str, value: float, forwards=None, batch: int = 0,
           unit: str = "samples/sec", lower_is_better: bool = False,
           trend_valid: bool = True, **extra) -> dict:
@@ -236,6 +263,20 @@ def _emit(metric: str, value: float, forwards=None, batch: int = 0,
     except Exception as exc:  # noqa: BLE001 — telemetry must not cost
         print(f"# registry snapshot unavailable: {exc!r}",  # the line
               file=sys.stderr)
+    # ISSUE 7 satellite: every line records the compile cost IT paid —
+    # cold compile seconds + persistent-cache hit/miss deltas since the
+    # previous line, so BENCH_r06 onward separates compile bill from
+    # throughput without rerunning anything
+    global _compile_base
+    cur = _compile_totals()
+    if cur is not None:
+        base = _compile_base or {k: 0 for k in cur}
+        out["compile"] = {
+            "cold_seconds": round(cur["cold_seconds"] -
+                                  base["cold_seconds"], 3),
+            "cache_hits": cur["cache_hits"] - base["cache_hits"],
+            "cache_misses": cur["cache_misses"] - base["cache_misses"]}
+        _compile_base = cur
     print(json.dumps(out), flush=True)
     return out
 
@@ -853,6 +894,154 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
         f"instrumentation overhead {overhead_pct:.2f}% >= 2%"
 
 
+def bench_compile_probe():
+    """One cold-or-warm boot measurement (the ``compile_probe`` child of
+    the ``compile_latency`` scenario): whether it is cold or warm is
+    decided by the cache directory the parent points
+    ``$ZNICZ_TPU_COMPILE_CACHE`` at.  Measures the two boot paths the
+    tentpole targets — the flagship training step's first dispatch
+    (trace + compile + run) and the serve engine's full bucket sweep —
+    and prints ONE JSON line with wall seconds + compile-cost counters."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.observe import compile_cache_stats
+    from znicz_tpu.serve import BatchEngine
+
+    # flagship-shaped training step (scaled to probe size: the number
+    # that matters is the RATIO between two identical probes)
+    prng.seed_all(7)
+    w = build_fused(max_epochs=1, layers=(512, 512), minibatch_size=128,
+                    n_train=256, n_valid=0)
+    w.initialize(device=TPUDevice())
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.normal(size=(1, 128, 28, 28)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, (1, 128)).astype(np.int32))
+    ms = jnp.ones((1, 128), bool)
+    t0 = time.perf_counter()
+    metrics = w.step.train_steps(xs, ys, ms)
+    float(jax.device_get(metrics["loss"]))
+    step_s = time.perf_counter() - t0
+
+    # serve bucket sweep: an MLP big enough that XLA compile time
+    # dominates the warm path's load-from-cache + run
+    w1 = jnp.asarray(rng.normal(0, 0.1, (256, 512)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(0, 0.1, (512, 512)).astype(np.float32))
+    w3 = jnp.asarray(rng.normal(0, 0.1, (512, 16)).astype(np.float32))
+
+    @jax.jit
+    def mlp(x):
+        return jnp.tanh(jnp.tanh(x @ w1) @ w2) @ w3
+
+    engine = BatchEngine(mlp, max_batch=32, input_shape=(256,))
+    t0 = time.perf_counter()
+    engine.warmup()
+    serve_s = time.perf_counter() - t0
+    hits, misses = compile_cache_stats()
+    print(json.dumps({"probe": "compile", "step_first_dispatch_s":
+                      round(step_s, 3), "serve_warmup_s": round(serve_s, 3),
+                      "serve_buckets": len(engine.buckets),
+                      "cache_hits": hits, "cache_misses": misses}),
+          flush=True)
+
+
+def _run_compile_probe(cache_dir: str) -> dict:
+    """Run one ``compile_probe`` child against ``cache_dir``; returns
+    its JSON line.  A fresh process per probe is the point: the in-
+    process jit/trace caches must not exist, so the only warmth is the
+    persistent cache."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ZNICZ_TPU_COMPILE_CACHE=cache_dir)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "compile_probe"], capture_output=True, text=True,
+        timeout=CPU_TIMEOUT, env=env, cwd=REPO)
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        if line.startswith("{"):
+            doc = json.loads(line)
+            if doc.get("probe") == "compile":
+                return doc
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    raise RuntimeError(f"compile_probe produced no result "
+                       f"(rc={proc.returncode}): {' | '.join(tail)}")
+
+
+def bench_compile_latency():
+    """ISSUE 7 scenario: cold-process vs warm-cache boot (CPU by design
+    — it measures the compile-latency plane's machinery, not the chip).
+    Two identical probe children share one FRESH cache directory: the
+    first pays every compile cold and populates the cache, the second
+    pays trace + cache-load only.  A third leg exports a forward
+    package with AOT executables and boots the serve engine from them,
+    pinning ``compile_count == 0``.  The line lands first; the
+    acceptance contracts (warm serve sweep <= 50% of cold, zero-compile
+    AOT boot) are ASSERTED after it flushes."""
+    import shutil
+    import tempfile
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.serve import BatchEngine
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils.export import ExportedForward, export_forward
+
+    cache_dir = tempfile.mkdtemp(prefix="znicz_cc_bench_")
+    pkg_dir = tempfile.mkdtemp(prefix="znicz_aot_bench_")
+    try:
+        cold = _run_compile_probe(cache_dir)
+        warm = _run_compile_probe(cache_dir)
+
+        # AOT leg: export -> precompile -> engine boot with zero compiles
+        prng.seed_all(23)
+        w = StandardWorkflow(
+            name="AotBench", loss_function="softmax",
+            layers=[{"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 64}},
+                    {"type": "softmax", "->": {"output_sample_shape": 10}}],
+            loader_name="synthetic_classifier",
+            loader_config={"n_classes": 10, "sample_shape": (32,),
+                           "n_train": 64, "n_valid": 0,
+                           "minibatch_size": 32},
+            decision_config={"max_epochs": 1})
+        w.initialize(device=TPUDevice())
+        w.run()
+        pkg = os.path.join(pkg_dir, "aot_bench.npz")
+        export_forward(w, pkg, aot_max_batch=16)
+        t0 = time.perf_counter()
+        engine = BatchEngine(ExportedForward(pkg), max_batch=16)
+        engine.warmup()
+        aot_boot_s = time.perf_counter() - t0
+        ratio = warm["serve_warmup_s"] / max(cold["serve_warmup_s"], 1e-9)
+        _emit("compile_latency_warm_serve_boot_seconds",
+              warm["serve_warmup_s"], unit="s", lower_is_better=True,
+              cpu=True, warm_over_cold=round(ratio, 3),
+              cold_serve_warmup_s=cold["serve_warmup_s"],
+              serve_buckets=cold["serve_buckets"],
+              step_first_dispatch_s={"cold": cold["step_first_dispatch_s"],
+                                     "warm": warm["step_first_dispatch_s"]},
+              cache_misses={"cold": cold["cache_misses"],
+                            "warm": warm["cache_misses"]},
+              cache_hits={"cold": cold["cache_hits"],
+                          "warm": warm["cache_hits"]},
+              aot_boot_s=round(aot_boot_s, 3),
+              aot_boot_compile_count=engine.compile_count,
+              aot_buckets=engine.aot_count)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(pkg_dir, ignore_errors=True)
+    # AFTER the emit so the measurement always lands: a broken contract
+    # must fail the scenario loudly, not ride a JSON field nobody greps
+    assert engine.compile_count == 0, \
+        f"AOT boot compiled {engine.compile_count} buckets (want 0)"
+    assert ratio <= 0.5, \
+        (f"warm serve bucket sweep at {ratio:.2f}x of cold "
+         f"(want <= 0.5): persistent cache is not paying for itself")
+
+
 def child_main(mode: str) -> None:
     if mode == "pipeline":
         # input-pipeline scenario: CPU by design (measures the prefetch
@@ -880,6 +1069,26 @@ def child_main(mode: str) -> None:
         jax.config.update("jax_platforms", "cpu")
         _enable_compile_cache()
         bench_metrics_overhead()
+        return
+    if mode == "compile_latency":
+        # compile-latency scenario: orchestrates two compile_probe
+        # children over a fresh shared cache dir + an AOT boot leg
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_compile_latency()
+        return
+    if mode == "compile_probe":
+        # one boot measurement; the cache dir arrives via
+        # $ZNICZ_TPU_COMPILE_CACHE (set by the compile_latency parent)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from znicz_tpu import compilecache
+
+        compilecache.configure(min_compile_time_s=0.0)
+        bench_compile_probe()
         return
     if mode == "cpu_fallback":
         # the axon sitecustomize pins jax_platforms via jax.config at
@@ -995,8 +1204,15 @@ def main():
     # serving-plane / input-pipeline / metrics-overhead scenarios: their
     # own CPU children (independent of the chip pool), BEFORE the final
     # flagship re-emit so the driver's last-line contract is untouched
-    for extra_mode in ("serve", "pipeline", "metrics_overhead"):
-        extra_results, note = _run_child(extra_mode, CPU_TIMEOUT,
+    for extra_mode in ("serve", "pipeline", "metrics_overhead",
+                       "compile_latency"):
+        # compile_latency's own legs each budget up to CPU_TIMEOUT (two
+        # fresh-process probes + the AOT export leg) — its OUTER timeout
+        # must exceed their sum or a slow-but-in-budget cold probe gets
+        # the whole scenario killed mid-warm-probe
+        budget = 4 * CPU_TIMEOUT if extra_mode == "compile_latency" \
+            else CPU_TIMEOUT
+        extra_results, note = _run_child(extra_mode, budget,
                                          platform="cpu")
         if note:
             notes.append(note)

@@ -13,6 +13,15 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 
+# ISSUE 7: the persistent compilation cache defaults ON in production but
+# stays OFF under the suite unless a test configures it explicitly
+# (tests/test_compilecache.py does, against tmp dirs).  A process-shared
+# on-disk cache couples hundreds of tests through ~/.cache for no extra
+# coverage, and XLA's concurrent cache-write path segfaulted (rarely) under
+# the threaded serve tests on this box — one crash would abort the whole
+# tier-1 process.  setdefault: an explicit env override still wins.
+os.environ.setdefault("ZNICZ_TPU_COMPILE_CACHE", "off")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax

@@ -27,4 +27,12 @@ if ! timeout -k 5 60 python tools/check_metric_catalogue.py; then
          "check_metric_catalogue lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 7 smoke: zero-JIT serve boot — export an AOT package, boot the
+# real serve CLI in a fresh jax-on-CPU process, scrape /metrics, assert
+# the engine compile counter is 0 (docs/COMPILE.md)
+if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/aot_smoke.py; then
+    echo "tools/t1.sh: AOT zero-JIT serve smoke FAILED (see aot_smoke" \
+         "lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 exit $rc

@@ -5,6 +5,7 @@ Usage:
     python -m znicz_tpu <workflow.py> [config.py ...] [options]
     python -m znicz_tpu forge {list,upload,fetch} ...
     python -m znicz_tpu serve <package.npz> [options]
+    python -m znicz_tpu aot <package.npz> [--max-batch N] [-o out.npz]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
     python -m znicz_tpu flight <flight_artifact.json> [--json]
 
@@ -191,6 +192,13 @@ def main(argv=None) -> int:
         from znicz_tpu.serve.server import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "aot":
+        # compile-latency plane (ISSUE 7): embed ahead-of-time serving
+        # executables into a forward package so `serve` boots with zero
+        # JIT on any host matching this one's backend fingerprint
+        from znicz_tpu.utils.export import aot_main
+
+        return aot_main(argv[1:])
     if argv and argv[0] == "flight":
         # flight-recorder post-mortem viewer: pretty-print one
         # observe/flight.py artifact (spans around the crash, rule

@@ -1,0 +1,251 @@
+"""Compile-latency plane (ISSUE 7 tentpole, part 1): the persistent XLA
+compilation cache as a first-class, observable subsystem.
+
+The bench trajectory's weakest signal is compile cost, not step speed:
+r05's flagship fell back to CPU after two TPU compile timeouts, and
+every ``serve`` boot re-JITs all engine buckets from scratch.  JAX ships
+a persistent compilation cache (serialized XLA executables keyed by a
+hash of the HLO + compile options + backend fingerprint); this module
+makes it config-driven, on by default, and assertable:
+
+- :func:`configure` resolves the cache directory from (in precedence
+  order) an explicit argument, ``$ZNICZ_TPU_COMPILE_CACHE``,
+  ``root.common.engine.compile_cache_dir``, and the default
+  ``~/.cache/znicz_tpu/xla`` — so one cluster-shared directory turns
+  every cold compile into a once-per-cluster cost.  ``"off"`` (or an
+  empty string) at any layer disables the cache.
+- :func:`ensure` is the idempotent boot hook called from
+  ``Workflow.run``, ``FusedTrainStep.initialize`` and the serve plane's
+  backend load — anywhere compiles are about to happen.  It never
+  *imports* jax: a numpy-device run stays jax-free, and the next
+  ensure() after jax appears finishes the job.
+- every cache consultation lands in the metrics registry
+  (``znicz_compile_cache_hits_total`` / ``_misses_total`` via
+  ``observe.probe.compile_cache_event``), so warm-vs-cold is a counter
+  delta — asserted by tests and the ``compile_latency`` bench scenario,
+  not inferred from wall-clock jitter.  The miss counter also feeds
+  ``watchtower.recompile_storm(metric="znicz_compile_cache_misses_
+  total")``.
+- failure paths degrade, never crash: an uncreatable directory logs a
+  warning and leaves caching off; ``jax_raise_persistent_cache_errors``
+  is pinned False so a corrupt entry at runtime is a logged cache miss.
+
+The entry-size/compile-time thresholds default to 0 (JAX's defaults
+skip sub-second compiles, which is every program in this repo's CPU
+test geometry — a warm serve boot would then hit nothing).  Production
+TPU programs clear the default thresholds anyway; see docs/COMPILE.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+from typing import Optional
+
+#: default cache location (ISSUE 7); one directory is safely shared by
+#: concurrent processes — entries are content-hashed and written
+#: atomically by jax
+DEFAULT_DIR = "~/.cache/znicz_tpu/xla"
+
+#: environment override: a directory path, or ""/"off" to disable
+ENV_VAR = "ZNICZ_TPU_COMPILE_CACHE"
+
+#: environment override for the minimum-compile-seconds threshold
+ENV_MIN_S = "ZNICZ_TPU_COMPILE_CACHE_MIN_S"
+
+_log = logging.getLogger("znicz_tpu.compilecache")
+
+_lock = threading.Lock()
+_configured = False                 # a configure() decision was made
+_active_dir: Optional[str] = None   # the enabled directory, or None
+_active_min_s: Optional[float] = None  # the applied threshold, or None
+_listener_registered = False
+
+
+def _resolve_dir(explicit: Optional[str]) -> Optional[str]:
+    """Layered resolution; ``None`` means caching is off."""
+    if explicit is None:
+        explicit = os.environ.get(ENV_VAR)
+    if explicit is None:
+        from znicz_tpu.core.config import root
+
+        explicit = root.common.engine.get("compile_cache_dir", None)
+    if explicit is None:
+        explicit = DEFAULT_DIR
+    explicit = str(explicit)
+    if explicit.lower() in ("", "off", "none", "0"):
+        return None
+    return os.path.expanduser(explicit)
+
+
+def _resolve_min_s(explicit: Optional[float]) -> float:
+    """Minimum-compile-seconds threshold; a malformed env value is a
+    warned-about 0, never a crash (the degrade contract)."""
+    if explicit is not None:
+        return float(explicit)
+    raw = os.environ.get(ENV_MIN_S, "0")
+    try:
+        return float(raw)
+    except ValueError:
+        _log.warning("%s=%r is not a number; using 0", ENV_MIN_S, raw)
+        return 0.0
+
+
+def _register_listener() -> None:
+    """Feed jax's cache-hit/miss monitoring events into the registry —
+    once per process, regardless of later reconfiguration."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    import jax._src.monitoring as _monitoring
+
+    from znicz_tpu.observe import probe
+
+    def _on_event(name: str, **kwargs) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            probe.compile_cache_event("hit")
+        elif name == "/jax/compilation_cache/cache_misses":
+            probe.compile_cache_event("miss")
+
+    _monitoring.register_event_listener(_on_event)
+    _listener_registered = True
+
+
+def _reset_jax_cache_state() -> None:
+    """jax latches whether-the-cache-is-used ONCE per process (and pins
+    the backing store to the directory live at first use) — so a
+    configure() that changes the decision after any compile already
+    happened must make jax forget, or the new directory is silently
+    never consulted (the first tier-1 compiles run with the cache off,
+    which is exactly how this was found)."""
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _jax_cc)
+
+        _jax_cc.reset_cache()
+    except Exception as exc:  # noqa: BLE001 — degrade, never crash
+        _log.debug("jax compilation-cache state reset unavailable: %r",
+                   exc)
+
+
+def configure(cache_dir: Optional[str] = None,
+              min_compile_time_s: Optional[float] = None,
+              force: bool = False) -> Optional[str]:
+    """Resolve + enable (or disable) the persistent compilation cache.
+
+    Returns the active cache directory, or ``None`` when caching is
+    off (explicitly, or because the directory could not be created —
+    the degraded path is a warning, never an exception).  Idempotent:
+    a second call is a no-op unless ``force`` or the arguments changed
+    the resolution."""
+    global _configured, _active_dir, _active_min_s
+    with _lock:
+        target = _resolve_dir(cache_dir)
+        min_s = _resolve_min_s(min_compile_time_s)
+        if (_configured and not force and target == _active_dir
+                and (target is None or min_s == _active_min_s)):
+            return _active_dir
+        import jax
+
+        if target is None:
+            # explicit off: a previously enabled in-process cache must
+            # actually stop being consulted
+            jax.config.update("jax_compilation_cache_dir", "")
+            _reset_jax_cache_state()
+            _configured, _active_dir, _active_min_s = True, None, None
+            _log.info("persistent compilation cache disabled")
+            return None
+        try:
+            os.makedirs(target, exist_ok=True)
+            probe_path = os.path.join(target, ".znicz_writable")
+            with open(probe_path, "w"):
+                pass
+            os.remove(probe_path)
+        except OSError as exc:
+            # graceful degradation (ISSUE 7 acceptance): every compile
+            # is a logged miss, nothing crashes
+            _log.warning("compile cache dir %r unusable (%s); persistent "
+                         "caching disabled — all compiles will be cold",
+                         target, exc)
+            # actually disable: a previously-enabled directory must stop
+            # being consulted, or stats() lies about the degraded state
+            jax.config.update("jax_compilation_cache_dir", "")
+            _reset_jax_cache_state()
+            _configured, _active_dir, _active_min_s = True, None, None
+            return None
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_compilation_cache_dir", target)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_s)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # a corrupt/truncated entry must be a miss, not a crash
+        jax.config.update("jax_raise_persistent_cache_errors", False)
+        _reset_jax_cache_state()
+        _register_listener()
+        _configured, _active_dir, _active_min_s = True, target, min_s
+        _log.info("persistent compilation cache at %s "
+                  "(min_compile_time_s=%g)", target, min_s)
+        return target
+
+
+def ensure() -> Optional[str]:
+    """Idempotent boot hook: configure the cache with layered defaults
+    the first time compiles are about to happen.  A process that never
+    imported jax is left untouched (a numpy-device workflow run must
+    not boot a backend just to configure a cache it will never use)."""
+    if _configured:
+        return _active_dir
+    if "jax" not in sys.modules:
+        return None
+    return configure()
+
+
+@contextlib.contextmanager
+def suspended():
+    """Take the persistent cache out of the loop for a block, process-
+    wide and atomically (the module lock is held throughout, so a
+    concurrent configure()/ensure() cannot re-enable it mid-block).
+    ``attach_aot`` needs this: serializing an executable that came out
+    of ANY cache drops its object code, so its compiles must be fresh.
+    Compiles on OTHER threads during the block run cold too — that is
+    the cost of a process-global jax config."""
+    if "jax" not in sys.modules:
+        yield
+        return
+    import jax
+
+    with _lock:
+        prev = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", "")
+        _reset_jax_cache_state()
+        try:
+            yield
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev or "")
+            _reset_jax_cache_state()
+
+
+def active_dir() -> Optional[str]:
+    """The enabled cache directory, or None (off / not yet configured)."""
+    return _active_dir
+
+
+def stats() -> dict:
+    """Cache state + lifetime hit/miss counters (the ``compile_latency``
+    bench and the serve warmup summary read the deltas)."""
+    from znicz_tpu.observe import probe
+
+    hits, misses = probe.compile_cache_stats()
+    return {"dir": _active_dir, "configured": _configured,
+            "hits": hits, "misses": misses}
+
+
+def _reset_for_tests() -> None:
+    """Forget the configure() decision so tests can re-resolve; the
+    monitoring listener stays registered (it is append-only in jax)."""
+    global _configured, _active_dir, _active_min_s
+    with _lock:
+        _configured, _active_dir, _active_min_s = False, None, None

@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from znicz_tpu import compilecache
 from znicz_tpu.core.plumbing import EndPoint, StartPoint
 from znicz_tpu.core.units import Unit
 from znicz_tpu.observe import probe
@@ -119,6 +120,11 @@ class Workflow(Unit):
         the signal queue drains."""
         if not self.initialized:
             raise RuntimeError("Workflow.run before initialize")
+        # compile-latency plane (ISSUE 7): any compiles this walk
+        # triggers should hit the persistent cache; a numpy-device run
+        # (jax never imported) is left untouched, and a repeat call is
+        # one bool check
+        compilecache.ensure()
         started = time.monotonic()
         # telemetry plane: per-delivery spans + step-latency histogram +
         # recompile polling (observe.set_enabled(False) reduces the walk

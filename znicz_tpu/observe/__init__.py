@@ -26,7 +26,10 @@ from znicz_tpu.observe.registry import (REGISTRY, Registry, counter,
                                         quantile_from_buckets)
 from znicz_tpu.observe.trace import (TRACER, Tracer, export_trace,
                                      instant, span)
-from znicz_tpu.observe.probe import (check_recompiles, compile_observed,
+from znicz_tpu.observe.probe import (check_recompiles,
+                                     compile_cache_event,
+                                     compile_cache_stats,
+                                     compile_observed,
                                      enabled, resilience_event,
                                      set_enabled, staged_bytes,
                                      time_compiles, watch_compiles)
@@ -40,5 +43,6 @@ __all__ = ["REGISTRY", "Registry", "counter", "gauge", "histogram",
            "set_enabled", "enabled", "watch_compiles",
            "check_recompiles", "staged_bytes", "resilience_event",
            "compile_observed", "time_compiles",
+           "compile_cache_event", "compile_cache_stats",
            "WATCHTOWER", "Watchtower", "Rule", "TimeSeriesRing",
            "flight"]

@@ -26,6 +26,10 @@ hook                  call site
                       action lands as a counter increment AND an instant
                       event, so failures correlate with steps on one
                       timeline
+``compile_cache_      ``compilecache.py``'s jax monitoring listener —
+event``               every persistent compilation-cache consultation
+                      lands in ``znicz_compile_cache_{hits,misses}_
+                      total`` so warm-vs-cold boot is a counter delta
 ====================  =====================================================
 
 All hooks early-out on ``observe.set_enabled(False)`` (one module-global
@@ -271,6 +275,34 @@ def time_compiles(label: str, fn):
     if fn is None:
         return None
     return _CompileTimed(fn, label)
+
+
+# -- persistent compilation cache (ISSUE 7) ----------------------------------
+
+_CACHE_HITS = _reg.counter(
+    "znicz_compile_cache_hits_total",
+    "persistent XLA compilation-cache hits (an executable was loaded "
+    "from disk instead of compiled)")
+_CACHE_MISSES = _reg.counter(
+    "znicz_compile_cache_misses_total",
+    "persistent compilation-cache misses — cold compiles; feeds "
+    "watchtower.recompile_storm when pointed at this family")
+
+
+def compile_cache_event(kind: str) -> None:
+    """One cache consultation, fed by ``compilecache``'s jax monitoring
+    listener.  ``kind``: ``hit`` | ``miss``.  Counted even while probes
+    are disabled: the warm-vs-cold contract (tests, the
+    ``compile_latency`` bench, t1's zero-JIT smoke) must stay assertable
+    through an ``observe.set_enabled(False)`` window, and a compile is
+    not on any per-signal hot path."""
+    (_CACHE_HITS if kind == "hit" else _CACHE_MISSES).inc()
+
+
+def compile_cache_stats() -> tuple:
+    """Lifetime ``(hits, misses)`` — scenario lines and the serve
+    warmup summary report deltas of these."""
+    return int(_CACHE_HITS.get()), int(_CACHE_MISSES.get())
 
 
 # -- pipeline plane ----------------------------------------------------------

@@ -745,16 +745,26 @@ def nan_guard_trip_rate(max_per_s: float = 0.1, window_s: float = 60.0,
 
 
 def recompile_storm(max_in_window: float = 3.0, window_s: float = 60.0,
-                    action: Optional[Callable] = None) -> Rule:
+                    action: Optional[Callable] = None,
+                    metric: str = "znicz_recompiles_total") -> Rule:
     """Watched programs recompiling repeatedly after warmup — a shape
     leak (the serve engine's zero-steady-state-recompile property is
-    being violated somewhere)."""
+    being violated somewhere).  ``metric`` widens the net (ISSUE 7):
+    pointed at ``znicz_compile_cache_misses_total`` the rule counts
+    EVERY cold XLA compile the persistent cache observed — programs
+    nobody registered with ``watch_compiles`` included — so a serve
+    fleet alarms on compile storms a warm cache should have absorbed."""
+    # a non-default metric gets its own rule name, so a tower carrying
+    # both variants keeps their trips apart in znicz_watchtower_trips_
+    # total{rule=...} and flight-dump tags
+    name = ("recompile_storm" if metric == "znicz_recompiles_total"
+            else f"recompile_storm[{metric}]")
     return Rule(
-        "recompile_storm", "znicz_recompiles_total",
+        name, metric,
         lambda d: d > max_in_window, window_s=window_s, reduce="delta",
         action=action,
         description=f"> {max_in_window:g} recompiles inside "
-                    f"{window_s:g}s")
+                    f"{window_s:g}s ({metric})")
 
 
 def pipeline_consumer_starvation(ratio: float = 0.5,

@@ -714,6 +714,12 @@ class FusedTrainStep(Unit):
             if unit is not None and not unit.initialized:
                 unit.initialize(device=device, **kwargs)
                 unit.initialized = True
+        # compile-latency plane (ISSUE 7): the program builds below are
+        # the training path's cold compiles — route them through the
+        # persistent cache so a restarted process (or a second host on
+        # a shared cache dir) pays trace cost only
+        from znicz_tpu import compilecache
+        compilecache.ensure()
         if self.optimizer == "adam":
             # the adam branch reads lr/wd only; a configured L1 mix would
             # be silently dropped — refuse like the fused=False guard

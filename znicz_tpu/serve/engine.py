@@ -43,10 +43,14 @@ def bucket_sizes(max_batch: int) -> tuple:
     return tuple(sizes)
 
 
-def load_backend(path: str, prefer_native: bool = False):
+def load_backend(path: str, prefer_native: bool = False,
+                 aot: bool = True):
     """Load a utils/export.py forward package as an engine backend:
     the C++ ``NativeForward`` when requested and buildable (the no-JAX
-    serving path), else the jitted ``ExportedForward``."""
+    serving path), else the jitted ``ExportedForward``.  ``aot=False``
+    ignores embedded ahead-of-time executables (the ``--no-aot`` serve
+    flag); with the default, a fingerprint-matching package boots with
+    zero JIT compiles."""
     if prefer_native:
         from znicz_tpu.native import infer
 
@@ -54,7 +58,7 @@ def load_backend(path: str, prefer_native: bool = False):
             return infer.NativeForward(path)
     from znicz_tpu.utils.export import ExportedForward
 
-    return ExportedForward(path)
+    return ExportedForward(path, aot=aot)
 
 
 class BatchEngine(Logger):
@@ -84,10 +88,15 @@ class BatchEngine(Logger):
         self.input_shape = tuple(shape) if shape is not None else None
         self.meta = dict(getattr(model, "meta", {}) or {})
         self.compile_count = 0      # buckets materialized (first-run pads)
+        self.aot_count = 0          # buckets served by AOT executables
         self.run_count = 0          # batches executed
         self.rows_served = 0
         self._seen_buckets: set = set()
         self._lock = threading.Lock()
+        # compile-latency plane (ISSUE 7): serve boot is a primary
+        # compile site — no-op for jax-free backends (native C++)
+        from znicz_tpu import compilecache
+        compilecache.ensure()
 
     # -- shape policy --------------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -105,7 +114,10 @@ class BatchEngine(Logger):
 
     def warmup(self, input_shape=None) -> int:
         """Run one zero batch per bucket so every serving shape is
-        compiled before traffic arrives; returns the compile count."""
+        compiled (or its AOT executable validated) before traffic
+        arrives; returns the compile count — 0 on a full ahead-of-time
+        boot.  Boot cost is one greppable summary line: bucket count,
+        total seconds, compiled vs AOT split, persistent-cache hits."""
         shape = input_shape if input_shape is not None else self.input_shape
         if shape is None:
             raise ValueError("warmup needs input_shape (the model does "
@@ -116,8 +128,18 @@ class BatchEngine(Logger):
             # validates the package end to end
             self.run(np.zeros((1,) + self.input_shape, np.float32))
             return 0
+        from znicz_tpu.observe import probe as _probe
+
+        hits0, _misses0 = _probe.compile_cache_stats()
+        t0 = time.perf_counter()
         for b in self.buckets:
             self.run(np.zeros((b,) + self.input_shape, np.float32))
+        dt = time.perf_counter() - t0
+        hits, _misses = _probe.compile_cache_stats()
+        self.info(f"warmup: {len(self.buckets)} buckets in {dt:.2f}s — "
+                  f"{self.compile_count} compiled, {self.aot_count} "
+                  f"aot-precompiled, {hits - hits0} persistent-cache "
+                  f"hits")
         return self.compile_count
 
     # -- execution -----------------------------------------------------------
@@ -142,10 +164,20 @@ class BatchEngine(Logger):
         with self._lock:
             if self.static_shapes and bucket not in self._seen_buckets:
                 self._seen_buckets.add(bucket)
-                self.compile_count += 1
-                compiled = True
-                self.debug(f"compiling bucket {bucket} "
-                           f"({self.compile_count}/{len(self.buckets)})")
+                if bucket in getattr(self.model, "precompiled_buckets",
+                                     ()):
+                    # ahead-of-time executable: materializing it is a
+                    # deserialized-program first run, NOT a compile —
+                    # the zero-JIT boot contract (compile_count == 0)
+                    # is asserted on exactly this distinction
+                    self.aot_count += 1
+                    self.debug(f"bucket {bucket} from AOT executable "
+                               f"({self.aot_count} precompiled)")
+                else:
+                    self.compile_count += 1
+                    compiled = True
+                    self.debug(f"compiling bucket {bucket} "
+                               f"({self.compile_count}/{len(self.buckets)})")
             t0 = time.perf_counter()
             y = np.asarray(self.model(x))
             dt = time.perf_counter() - t0
@@ -172,6 +204,7 @@ class BatchEngine(Logger):
                 "buckets": list(self.buckets),
                 "static_shapes": self.static_shapes,
                 "compile_count": self.compile_count,
+                "aot_count": self.aot_count,
                 "run_count": self.run_count,
                 "rows_served": self.rows_served,
             }

@@ -16,6 +16,13 @@ telemetry needed to operate the thing is one GET away.
 
 CLI:  python -m znicz_tpu serve <package.npz> [--port N] [--max-batch N]
           [--max-wait-ms F] [--max-queue N] [--native] [--no-warmup]
+          [--no-aot]
+
+A package carrying ahead-of-time executables (``python -m znicz_tpu
+aot``, docs/COMPILE.md) boots with ``compile_count == 0`` when its
+backend fingerprint matches this host; otherwise the loader logs the
+mismatch and warmup JIT-compiles each bucket through the persistent
+compilation cache as before.
 """
 
 from __future__ import annotations
@@ -183,6 +190,9 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "request path) when buildable")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the batch buckets")
+    p.add_argument("--no-aot", action="store_true",
+                   help="ignore embedded ahead-of-time executables and "
+                        "JIT every bucket (docs/COMPILE.md)")
     p.add_argument("--smoke-test", action="store_true",
                    help="start, serve one self-request, exit (CI probe)")
     return p
@@ -191,7 +201,8 @@ def build_serve_parser() -> argparse.ArgumentParser:
 def serve_main(argv) -> int:
     args = build_serve_parser().parse_args(argv)
     try:
-        backend = load_backend(args.package, prefer_native=args.native)
+        backend = load_backend(args.package, prefer_native=args.native,
+                               aot=not args.no_aot)
     except (OSError, ValueError, RuntimeError) as exc:
         print(f"serve: cannot load {args.package!r}: {exc}")
         return 2

@@ -43,6 +43,20 @@ _som_batch_step_jit = jax.jit(_som_batch_step,
                               static_argnames=("pallas", "interpret"))
 
 
+@jax.jit
+def _winners_jit(x, w):
+    """Winner indices per sample — module-level (ISSUE 7 satellite):
+    the previous per-``xla_init`` ``jax.jit(lambda ...)`` gave every
+    KohonenForward build a fresh empty trace cache, so repeated builds
+    in one process (supervised restarts, warm-up-then-time benches,
+    forge reloads) re-traced and re-looked-up a program jit already
+    had.  One module-level jitted function memoizes per (shape, dtype)
+    for the life of the process — the same fix ``_epoch_scan`` records
+    for the scan path — and the persistent compilation cache
+    (znicz_tpu.compilecache) carries the compile across processes."""
+    return k_ops.winners(jnp, x, w).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("pallas", "interpret"))
 def _epoch_scan(dataset, w, coords, idxs, ms, alpha, radius, *,
                 pallas: bool, interpret: bool):
@@ -299,8 +313,7 @@ class KohonenForward(KohonenBase):
             self.hits += np.bincount(idx[:bs], minlength=self.n_neurons)
 
     def xla_init(self) -> None:
-        self._xla_fn = jax.jit(
-            lambda x, w: k_ops.winners(jnp, x, w).astype(jnp.int32))
+        self._xla_fn = _winners_jit
 
     def xla_run(self) -> None:
         self.input.unmap()
