@@ -397,6 +397,34 @@ def test_watchdog_detects_injected_hang(tmp_path):
     assert report.workflow.decision.metrics_history == full_hist
 
 
+def test_watchdog_captures_hung_stack_into_flight(tmp_path):
+    """ISSUE 9 satellite: on hang detection the watchdog freezes the
+    hung thread's stack (sys._current_frames) BEFORE interrupting it,
+    and the flight artifact carries it — the post-mortem shows WHERE
+    the step stalled (here: inside the injected hang's abort-wait in
+    faults.py), not just that it did."""
+    import json
+
+    snap_dir = tmp_path / "hang"
+    plan = faults.FaultPlan()
+    plan.hang_at("workflow.step", seconds=60.0, when=lambda workflow, unit:
+                 int(workflow.decision.epoch_number) == 1)
+    with faults.active(plan):
+        report = run_supervised(
+            lambda: build(2, snap_dir), str(snap_dir),
+            fast_policy(step_timeout=2.0, hang_grace=5.0))
+    assert report.hang_events == 1
+    assert report.flights, "no flight artifact dumped"
+    with open(report.flights[0]) as f:
+        doc = json.load(f)
+    stack = doc["extra"].get("hung_stack")
+    assert stack, "flight artifact carries no hung_stack"
+    joined = "".join(stack)
+    # the stack names the actual stall point: the injected hang's
+    # cooperative wait inside the fault plan
+    assert "faults.py" in joined and "_hang" in joined, joined[-2000:]
+
+
 # -- NaN/Inf health guard ----------------------------------------------------
 
 def test_health_guard_skip_batch_on_nan_loss(tmp_path):

@@ -539,3 +539,76 @@ def test_cli_serve_missing_package_fails_cleanly(capsys):
 
     assert cli_main(["serve", "/nonexistent/pkg.npz"]) == 2
     assert "cannot load" in capsys.readouterr().out
+
+
+# -- chaos: kill-mid-request against an AOT-booted engine (ISSUE 9) ----------
+
+def test_chaos_kill_mid_request_aot_boot_exact_terminal_responses(tmp_path):
+    """Elastic-PR satellite: an AOT-booted engine (zero-JIT,
+    ``compile_count == 0``) is crashed mid-traffic by injected
+    ``serve.run`` faults.  Every admitted request still gets EXACTLY ONE
+    terminal response (a result or an error — never silence, never a
+    duplicate), and after the drain the engine has still compiled
+    nothing: crash recovery must not smuggle recompiles into the
+    zero-JIT serving contract."""
+    pytest.importorskip("jax")
+    from znicz_tpu.resilience import faults
+    from znicz_tpu.utils.export import ExportedForward, attach_aot
+
+    pkg = _export_tiny_package(tmp_path)
+    attach_aot(pkg, max_batch=8)
+    fwd = ExportedForward(pkg)
+    assert fwd.aot_fallback_reason is None
+    engine = BatchEngine(fwd, max_batch=8, input_shape=(6,))
+    assert engine.warmup() == 0                 # AOT boot: nothing to JIT
+    assert engine.compile_count == 0
+    batcher = MicroBatcher(engine, max_wait_ms=2.0, max_queue=256,
+                           default_timeout_s=60.0)
+    plan = faults.FaultPlan(seed=11)
+    for hit in (4, 9, 15):                      # three seeded mid-run kills
+        plan.crash_at("serve.run", at_hit=hit)
+    n_clients, per_client = 6, 8
+    outcomes: dict = {}
+    lock = threading.Lock()
+
+    def client(cid):
+        rng = np.random.default_rng(cid)
+        for i in range(per_client):
+            n = int(rng.integers(1, 5))
+            x = rng.normal(size=(n, 6)).astype(np.float32)
+            try:
+                y = batcher.predict(x)
+                kind = ("ok", y.shape)
+            except Exception as exc:  # noqa: BLE001 — terminal error
+                kind = ("error", type(exc).__name__)
+            with lock:
+                # exactly-once: a duplicate terminal response would
+                # overwrite and be caught by the count below
+                assert (cid, i) not in outcomes
+                outcomes[(cid, i)] = kind
+
+    with faults.active(plan):
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        batcher.stop(drain=True)
+    assert len(plan.log) == 3, plan.log         # every armed kill fired
+    assert len(outcomes) == n_clients * per_client
+    oks = sum(1 for kind in outcomes.values() if kind[0] == "ok")
+    errs = sum(1 for kind in outcomes.values() if kind[0] == "error")
+    assert errs >= 1 and oks >= 1
+    snap = batcher.metrics.snapshot()
+    # ledger closes: every admitted chunk either completed or rode one
+    # of the 3 failed batches ("errors" counts BATCH failures); nothing
+    # timed out, nothing vanished in the drain
+    assert snap["errors"] == 3
+    failed_chunks = snap["admitted"] - snap["completed"]
+    assert failed_chunks >= snap["errors"]
+    assert snap["timed_out"] == 0
+    assert snap["completed"] >= oks             # oversize requests chunk
+    # THE satellite pin: chaos + drain never compiled anything
+    assert engine.compile_count == 0
+    assert engine.stats()["aot_count"] >= 1

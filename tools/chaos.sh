@@ -4,8 +4,12 @@
 #   bash tools/chaos.sh             # full chaos suite
 #   bash tools/chaos.sh -k hang     # one scenario
 # Drives the real code paths (workflow step loop, snapshot save path,
-# serve engine) through znicz_tpu/resilience/faults.py hook sites; see
-# docs/RESILIENCE.md for the fault model and how to add a scenario.
+# serve engine, elastic worker processes) through
+# znicz_tpu/resilience/faults.py hook sites; see docs/RESILIENCE.md for
+# the fault model and how to add a scenario.  tests/test_elastic.py is
+# the multi-PROCESS half: real workers SIGKILL'd and resumed by the
+# fleet supervisor.
 cd "$(dirname "$0")/.." || exit 1
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+    tests/test_elastic.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -1,0 +1,78 @@
+"""Elastic kill-and-resume smoke for tools/t1.sh (ISSUE 9): start 2 CPU
+worker processes under the fleet supervisor, SIGKILL the snapshot-writer
+at a seeded step, resume at world size 1, and assert the fleet
+completed, dumped >= 1 flight artifact, and counted >= 1 resume.
+
+Fast by construction: 3 epochs of the tiny drill workflow
+(tools/elastic_workflow.py), compile cache off, one restart round.
+Exit 0 on success; any failure prints one ``elastic_smoke:`` line and
+exits 1.
+"""
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from znicz_tpu.observe import probe
+    from znicz_tpu.resilience import faults
+    from znicz_tpu.resilience.elastic import run_elastic
+    from znicz_tpu.resilience.supervisor import SupervisorPolicy
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    # XLA's concurrent persistent-cache writes are flaky on this box
+    # (see tests/conftest.py) — the smoke must not inherit that risk
+    env["ZNICZ_TPU_COMPILE_CACHE"] = "off"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ZNICZ_TPU_ELASTIC_EPOCHS"] = "3"
+    # kill the WRITER (rank 0) mid-epoch-2: deterministic resume point
+    plan = faults.FaultPlan(seed=99).kill_at("elastic.worker", at_hit=40)
+    counts0 = probe.elastic_counts()
+    with tempfile.TemporaryDirectory(prefix="znicz_elastic_smoke_") as tmp:
+        snap_dir = os.path.join(tmp, "snaps")
+        try:
+            report = run_elastic(
+                [os.path.join(REPO, "tools", "elastic_workflow.py")],
+                snap_dir, workers=2, world_sizes=[2, 1], prefix="ew",
+                policy=SupervisorPolicy(max_restarts=2,
+                                        backoff_base=0.01),
+                env=env, fault_plans={0: plan}, term_grace=8.0,
+                round_timeout=240.0)
+        except Exception as exc:  # noqa: BLE001 — one-line verdict
+            print(f"elastic_smoke: FAILED — fleet raised {exc!r}")
+            return 1
+        counts = probe.elastic_counts()
+        problems = []
+        if not report.completed:
+            problems.append("fleet did not complete")
+        if report.restarts < 1:
+            problems.append("seeded kill never caused a restart")
+        flights = [p for p in report.flights if os.path.isfile(p)]
+        if not flights:
+            problems.append("no flight artifact dumped")
+        if counts["resumes"] - counts0["resumes"] < 1:
+            problems.append("znicz_elastic_resumes_total did not move")
+        if not os.path.isfile(os.path.join(snap_dir, "history_0.json")):
+            problems.append("resumed worker wrote no history")
+        if problems:
+            print(f"elastic_smoke: FAILED — {'; '.join(problems)}; "
+                  f"report={report.as_dict()}")
+            return 1
+        print(f"elastic_smoke: ok — {report.restarts} restart, "
+              f"resumed at world size {report.world_size}, "
+              f"{len(flights)} flight artifact(s), counters "
+              f"{counts['restarts'] - counts0['restarts']}/"
+              f"{counts['worker_deaths'] - counts0['worker_deaths']}/"
+              f"{counts['resumes'] - counts0['resumes']} "
+              f"(restarts/deaths/resumes)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,4 +35,13 @@ if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/aot_smoke.py; then
          "lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 9 smoke: elastic kill-and-resume — 2 CPU worker processes, the
+# snapshot writer SIGKILL'd at a seeded step, fleet resumes at world
+# size 1; asserts completion + >= 1 flight artifact + resumes counter
+# (docs/RESILIENCE.md "Elastic multi-process")
+if ! timeout -k 5 300 env JAX_PLATFORMS=cpu python tools/elastic_smoke.py; then
+    echo "tools/t1.sh: elastic kill-and-resume smoke FAILED (see" \
+         "elastic_smoke lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 exit $rc

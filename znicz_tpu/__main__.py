@@ -8,6 +8,7 @@ Usage:
     python -m znicz_tpu aot <package.npz> [--max-batch N] [-o out.npz]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
     python -m znicz_tpu flight <flight_artifact.json> [--json]
+    python -m znicz_tpu elastic --workers N --snap-dir D <workflow.py> ...
 
 The workflow file must expose ``run(load, main)`` (every models/ sample
 does); config files are executed Python mutating the global ``root`` tree;
@@ -181,6 +182,19 @@ def forge_main(argv) -> int:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "elastic":
+        # the multi-process fleet supervisor (resilience/elastic.py):
+        # spawns N of THIS CLI as workers and supervises them — dispatch
+        # before the env hooks below, which are worker-side only
+        from znicz_tpu.resilience.elastic import elastic_main
+
+        return elastic_main(argv[1:])
+    # cross-process chaos (ISSUE 9): an elastic drill serializes its
+    # seeded fault plan into the worker env; installing it here covers
+    # every subcommand's real code paths.  No env var = one dict lookup.
+    from znicz_tpu.resilience import faults as _faults
+
+    _faults.install_from_env()
     if argv and argv[0] == "forge":
         site = apply_site_config()            # site may set the forge dir
         if site:
@@ -215,6 +229,30 @@ def main(argv=None) -> int:
             return 2
         return main(list(argv[2:]) + ["--trace", argv[1]])
     args = build_parser().parse_args(argv)
+    import os
+
+    # elastic-fleet liveness (ISSUE 9): the beat must start BEFORE the
+    # multihost join — jax import + coordinator wait + initialize can
+    # exceed any sane heartbeat_timeout, and a silent boot window would
+    # read as a wedged process.  The progress source is patched in once
+    # the launcher exists below; until then the beat carries -1
+    # ("process alive, no workflow yet").
+    _hb_box: dict = {"launcher": None}
+    hb_path = os.environ.get("ZNICZ_TPU_HEARTBEAT")
+    if hb_path:
+        from znicz_tpu.resilience.elastic import start_heartbeat
+
+        def _hb_progress():
+            launcher = _hb_box["launcher"]
+            if launcher is None or launcher.workflow is None:
+                return -1
+            return getattr(launcher.workflow, "signals_dispatched", -1)
+
+        start_heartbeat(
+            hb_path,
+            interval=float(os.environ.get(
+                "ZNICZ_TPU_HEARTBEAT_INTERVAL", "0.25")),
+            progress=_hb_progress)
     if args.coordinator is not None:
         multihost(args.coordinator, args.num_processes, args.process_id)
     prng.seed_all(args.random_seed)
@@ -257,6 +295,7 @@ def main(argv=None) -> int:
                         snapshot=args.snapshot, stealth=args.stealth,
                         profile_dir=args.profile,
                         manhole_path=args.manhole)
+    _hb_box["launcher"] = launcher   # heartbeat now reports real progress
     if args.optimize is not None:
         if args.publish is not None:
             print("--publish cannot be combined with --optimize "

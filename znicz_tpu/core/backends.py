@@ -80,7 +80,11 @@ class TPUDevice(Device):
     def __init__(self, device: Optional[jax.Device] = None,
                  precision: Optional[str] = None) -> None:
         super().__init__()
-        self.jax_device = device if device is not None else jax.devices()[0]
+        # local_devices, not devices: after a jax.distributed join,
+        # jax.devices()[0] is process 0's device — non-addressable from
+        # every other rank.  Single-process they are identical.
+        self.jax_device = device if device is not None \
+            else jax.local_devices()[0]
         self.precision = precision or root.common.engine.get("precision", "bfloat16")
         self.platform = self.jax_device.platform
 

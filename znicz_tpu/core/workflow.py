@@ -157,6 +157,11 @@ class Workflow(Unit):
                         # installed this is a single global None check
                         fault_hook("workflow.step", workflow=self,
                                    unit=target)
+                        # cross-process chaos site (ISSUE 9): same
+                        # cadence, NO context kwargs — the only trigger
+                        # that serializes into a worker's env is at_hit,
+                        # and elastic kill drills arm exactly that
+                        fault_hook("elastic.worker")
                         self.signals_dispatched += 1
                         target._signal(source, queue)
                     except BaseException:
@@ -192,6 +197,7 @@ class Workflow(Unit):
                 else:
                     fault_hook("workflow.step", workflow=self,
                                unit=target)
+                    fault_hook("elastic.worker")
                     self.signals_dispatched += 1
                     target._signal(source, queue)
                 if self.end_point.reached:

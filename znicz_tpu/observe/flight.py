@@ -52,7 +52,10 @@ SCHEMA = "znicz_tpu.flight/1"
 #: singletons); ``dir=None`` keeps auto_dump a no-op
 _config = {"dir": None, "last_spans": 256, "last_samples": 120,
            "log_lines": 200, "min_interval_s": 1.0}
-_last_auto_dump = 0.0
+# None, not 0.0: time.monotonic() counts from BOOT on Linux, so on a
+# machine (or container) up for less than min_interval_s a 0.0 sentinel
+# reads as "dumped recently" and silently suppresses the first artifact
+_last_auto_dump: Optional[float] = None
 
 
 def configure(dir: Optional[str] = None, last_spans: int = 256,
@@ -200,7 +203,8 @@ def auto_dump(reason: str, **ctx) -> Optional[str]:
     if _config["dir"] is None:
         return None
     now = time.monotonic()
-    if now - _last_auto_dump < _config["min_interval_s"]:
+    if _last_auto_dump is not None and \
+            now - _last_auto_dump < _config["min_interval_s"]:
         return None
     try:
         path = dump(reason=reason, extra=ctx)

@@ -334,3 +334,55 @@ def resilience_event(kind: str, site: str = "", **args) -> None:
         return
     _RESILIENCE.labels(kind=kind, site=site).inc()
     _trace.instant(f"resilience.{kind}", site=site, **args)
+
+
+# -- elastic fleet (ISSUE 9) -------------------------------------------------
+
+_ELASTIC_RESTARTS = _reg.counter(
+    "znicz_elastic_restarts_total",
+    "elastic fleet restart rounds (a worker died or hung; the remainder "
+    "was killed and the fleet relaunched)")
+_ELASTIC_DEATHS = _reg.counter(
+    "znicz_elastic_worker_deaths_total",
+    "worker processes observed dead without being asked to stop",
+    labelnames=("cause",))
+_ELASTIC_RESUMES = _reg.counter(
+    "znicz_elastic_resumes_total",
+    "fleet relaunches that resumed from a valid snapshot (vs cold "
+    "restarts)")
+_ELASTIC_WORLD = _reg.gauge(
+    "znicz_elastic_world_size",
+    "worker-process count of the currently running fleet round (0 when "
+    "no fleet is up)")
+
+
+def elastic_event(kind: str, **args) -> None:
+    """One elastic-fleet lifecycle event: counter + timeline instant.
+    ``kind``: restart | resume | worker_death (``cause`` = exit |
+    signal | hung | boot | wedged).  Counted in the SUPERVISOR process
+    — workers keep their own registries."""
+    if not _enabled:
+        return
+    if kind == "worker_death":
+        _ELASTIC_DEATHS.labels(cause=args.get("cause", "exit")).inc()
+    elif kind == "restart":
+        _ELASTIC_RESTARTS.inc()
+    elif kind == "resume":
+        _ELASTIC_RESUMES.inc()
+    _trace.instant(f"elastic.{kind}", **args)
+
+
+def elastic_world_size(n: int) -> None:
+    """Gauge: the fleet's live world size (set at each round launch,
+    zeroed when the fleet returns)."""
+    _ELASTIC_WORLD.set(float(n))
+
+
+def elastic_counts() -> dict:
+    """Lifetime elastic counters — the drill asserts these match its
+    event counts."""
+    deaths = sum(child.get() for _, child in _ELASTIC_DEATHS.items())
+    return {"restarts": int(_ELASTIC_RESTARTS.get()),
+            "worker_deaths": int(deaths),
+            "resumes": int(_ELASTIC_RESUMES.get()),
+            "world_size": int(_ELASTIC_WORLD.get())}

@@ -730,7 +730,10 @@ class FusedTrainStep(Unit):
                     f"l1_vs_l2 is SGD-only (adam applies decoupled L2 "
                     f"weight decay); set it to 0 on: {bad}")
         if self.mesh is None:
-            self.mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+            # local_devices: under a jax.distributed join, devices()[0]
+            # belongs to process 0 — a default mesh must be addressable
+            # from THIS rank (the elastic fleet's standalone-SPMD path)
+            self.mesh = Mesh(np.array(jax.local_devices()[:1]), ("data",))
         n_data = self.mesh.shape["data"]
         if self.loader is not None and \
                 self.loader.max_minibatch_size % n_data != 0:

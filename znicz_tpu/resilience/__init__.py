@@ -24,6 +24,10 @@ Modules:
 - :mod:`znicz_tpu.resilience.health` — per-step NaN/Inf guard with
   skip-batch or rollback degradation, trip counters surfaced through
   ``WebStatus``.
+- :mod:`znicz_tpu.resilience.elastic` — ``run_elastic``: the
+  multi-PROCESS fleet supervisor (heartbeat + exit-code watch, SIGKILL
+  a worker and the fleet resumes from the newest valid snapshot at a
+  possibly different world size).
 """
 
 import importlib
@@ -46,6 +50,8 @@ _EXPORTS = {
     "find_latest_valid_snapshot": "supervisor",
     "run_supervised": "supervisor",
     "HealthGuard": "health",
+    "ElasticExhausted": "elastic", "ElasticReport": "elastic",
+    "run_elastic": "elastic", "start_heartbeat": "elastic",
 }
 
 __all__ = sorted(_EXPORTS)

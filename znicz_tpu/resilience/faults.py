@@ -20,6 +20,12 @@ site                 hook location
 ``step.params``      ``parallel/step.py`` after a train dispatch —
                      value-poison site (NaN into the param pytree, the
                      observable effect of NaN gradients)
+``elastic.worker``   ``core/workflow.py`` run loop, same cadence as
+                     ``workflow.step`` but with NO context kwargs — the
+                     cross-process site: elastic-fleet drills arm it via
+                     the ``ZNICZ_TPU_FAULT_PLAN`` env (``at_hit`` only;
+                     predicates cannot cross a process boundary), usually
+                     with the ``kill`` action
 ===================  ======================================================
 
 Chaos tests therefore exercise the *real* step loop / save path / serving
@@ -45,10 +51,25 @@ Fault actions:
   (raising :class:`HangInterrupted`) instead of leaking a stuck thread
 - ``nan``     — value-poison: ``poison(site, value)`` returns a NaN-filled
   copy at the armed hit (scalars and array pytrees)
+- ``kill``    — ``SIGKILL`` the OWN process: no exception, no cleanup, no
+  atexit, no snapshot — the honest simulation of an OOM-killed / preempted
+  worker for multi-process drills.  Never arm it in-process in a test
+  runner; it is meant for worker subprocesses via the env plan.
+
+Cross-process plans: the elastic fleet supervisor serializes a plan into
+each worker's environment as ``ZNICZ_TPU_FAULT_PLAN`` (``plan.to_env()`` /
+``install_from_env()``, called by ``python -m znicz_tpu`` at boot).  Only
+deterministic triggers survive the boundary — ``site``/``action``/
+``at_hit``/``seconds``/``once`` — so a seeded kill drill reproduces
+exactly in the worker; plans with ``when`` predicates refuse to
+serialize.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import signal as _signal
 import threading
 import time
 from typing import Callable, Optional
@@ -85,11 +106,12 @@ class _Fault:
 class FaultPlan:
     """A seeded set of armed faults plus per-site hit counters."""
 
-    ACTIONS = ("crash", "oserror", "hang", "nan")
+    ACTIONS = ("crash", "oserror", "hang", "nan", "kill")
 
     def __init__(self, seed: int = 0) -> None:
         #: seeded generator for tests to derive "random" trigger points
         #: (epochs, hit counts) reproducibly
+        self.seed = int(seed)
         self.rng = np.random.default_rng(seed)
         self.hits: dict[str, int] = {}
         self.log: list[dict] = []       # every fired fault, for assertions
@@ -127,6 +149,40 @@ class FaultPlan:
     def nan_at(self, site: str, at_hit: Optional[int] = None,
                **kw) -> "FaultPlan":
         return self.arm(site, "nan", at_hit=at_hit, **kw)
+
+    def kill_at(self, site: str, at_hit: Optional[int] = None,
+                **kw) -> "FaultPlan":
+        return self.arm(site, "kill", at_hit=at_hit, **kw)
+
+    # -- cross-process serialization (ZNICZ_TPU_FAULT_PLAN) ------------------
+    def to_env(self) -> str:
+        """Serialize for a worker subprocess's environment.  Only the
+        deterministic trigger survives (``at_hit``); a plan carrying a
+        ``when`` predicate refuses loudly — closures cannot cross a
+        process boundary, and silently dropping the condition would turn
+        a seeded drill into fire-on-every-hit."""
+        specs = []
+        for f in self._faults:
+            if f.when is not None:
+                raise ValueError(
+                    f"fault at {f.site!r} has a `when` predicate; "
+                    f"predicates cannot be serialized into a worker env "
+                    f"— arm with at_hit instead")
+            specs.append({"site": f.site, "action": f.action,
+                          "at_hit": f.at_hit, "seconds": f.seconds,
+                          "once": f.once})
+        return json.dumps({"seed": self.seed, "faults": specs})
+
+    @classmethod
+    def from_env(cls, text: str) -> "FaultPlan":
+        doc = json.loads(text)
+        plan = cls(seed=int(doc.get("seed", 0)))
+        for spec in doc["faults"]:
+            plan.arm(spec["site"], spec["action"],
+                     at_hit=spec.get("at_hit"),
+                     seconds=float(spec.get("seconds", 30.0)),
+                     once=bool(spec.get("once", True)))
+        return plan
 
     # -- watchdog integration ------------------------------------------------
     def interrupt_hangs(self) -> None:
@@ -173,6 +229,18 @@ class FaultPlan:
                                 hit=hit)
         _flight.auto_dump("fault", site=site, action=fault.action,
                           hit=hit)
+        if fault.action == "kill":
+            # simulated SIGKILL: die NOW, exactly like the OOM killer —
+            # the elastic fleet's post-mortem comes from its own side.
+            # Flush stdio first so a worker's last log lines reach the
+            # supervisor's pump threads.
+            import sys
+            for stream in (sys.stdout, sys.stderr):
+                try:
+                    stream.flush()
+                except Exception:  # noqa: BLE001
+                    pass
+            os.kill(os.getpid(), _signal.SIGKILL)
         if fault.action == "crash":
             raise FaultInjected(f"injected crash at {site} hit {hit}")
         if fault.action == "oserror":
@@ -219,6 +287,25 @@ def _nan_like(value):
 
 # -- process-global registry -------------------------------------------------
 _PLAN: Optional[FaultPlan] = None
+
+#: worker subprocesses receive their armed plan through this variable
+#: (set by resilience/elastic.py, consumed by ``python -m znicz_tpu``)
+PLAN_ENV_VAR = "ZNICZ_TPU_FAULT_PLAN"
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    """Install the plan serialized in ``$ZNICZ_TPU_FAULT_PLAN`` when one
+    is set (no-op otherwise).  A malformed plan raises — a kill drill
+    whose plan was silently dropped would "pass" by never killing."""
+    text = os.environ.get(PLAN_ENV_VAR)
+    if not text:
+        return None
+    try:
+        plan = FaultPlan.from_env(text)
+    except (ValueError, KeyError, TypeError) as exc:
+        raise ValueError(
+            f"malformed {PLAN_ENV_VAR} ({exc!r}): {text[:200]!r}") from exc
+    return install(plan)
 
 
 def install(plan: FaultPlan) -> FaultPlan:

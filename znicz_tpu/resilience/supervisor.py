@@ -29,8 +29,10 @@ from __future__ import annotations
 import glob
 import os
 import re
+import sys
 import threading
 import time
+import traceback
 from typing import Callable, Optional
 
 import numpy as np
@@ -171,7 +173,20 @@ class _Watchdog:
         self.step_timeout = step_timeout
         self.hang_grace = hang_grace
         self.error: Optional[BaseException] = None
+        #: the hung worker thread's stack, captured at stall-detection
+        #: time (BEFORE the hang interrupt unwinds it) — the flight
+        #: artifact's answer to "WHERE did the step stall", not just
+        #: "that it did"
+        self.hung_stack: list[str] = []
         self._done = threading.Event()
+
+    def _capture_stack(self, thread: threading.Thread) -> None:
+        try:
+            frame = sys._current_frames().get(thread.ident)
+            if frame is not None:
+                self.hung_stack = traceback.format_stack(frame)
+        except Exception:  # noqa: BLE001 — diagnostics must not fail the
+            pass           # failure path
 
     def _worker(self) -> None:
         try:
@@ -192,6 +207,7 @@ class _Watchdog:
             if progress != last:
                 last, last_change = progress, now
             elif now - last_change > self.step_timeout:
+                self._capture_stack(t)     # where is it stuck, exactly?
                 faults.interrupt_hangs()   # cooperative: injected hangs die
                 t.join(self.hang_grace)
                 if t.is_alive():
@@ -244,14 +260,17 @@ def run_supervised(workflow_factory: Callable, snap_dir: str,
                                     snapshot=os.path.basename(snap))
             log.info(f"supervisor: attempt {attempt} resumes from {snap}")
         error: Optional[BaseException] = None
+        hung_stack: list[str] = []
         if policy.step_timeout is None:
             try:
                 workflow.run()
             except Exception as exc:  # noqa: BLE001 — supervised surface
                 error = exc
         else:
-            error = _Watchdog(workflow, policy.step_timeout,
-                              policy.hang_grace).run()
+            watchdog = _Watchdog(workflow, policy.step_timeout,
+                                 policy.hang_grace)
+            error = watchdog.run()
+            hung_stack = watchdog.hung_stack
         if error is None and bool(workflow.decision.complete):
             report.workflow = workflow
             return report
@@ -277,12 +296,16 @@ def run_supervised(workflow_factory: Callable, snap_dir: str,
             # Recorder failures degrade to a warning — they must not
             # consume another restart.
             try:
+                extra = {"attempt": attempt, "restarts": report.restarts,
+                         "error": repr(error),
+                         "error_type": type(error).__name__}
+                if hung_stack:
+                    # the post-mortem shows WHERE the step stalled
+                    extra["hung_stack"] = hung_stack
                 report.flights.append(_flight.dump(
                     dir=snap_dir,
                     reason="exhausted" if exhausted else "restart",
-                    extra={"attempt": attempt, "restarts": report.restarts,
-                           "error": repr(error),
-                           "error_type": type(error).__name__}))
+                    extra=extra))
             except Exception as flight_exc:  # noqa: BLE001
                 log.warning(f"supervisor: flight dump failed: "
                             f"{flight_exc!r}")

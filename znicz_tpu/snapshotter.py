@@ -19,6 +19,8 @@ from __future__ import annotations
 import json
 import os
 import re
+import sys
+import time
 import zlib
 from typing import Optional
 
@@ -32,6 +34,29 @@ from znicz_tpu.resilience.faults import fault_hook
 from znicz_tpu.resilience.retry import DEFAULT_IO_RETRY
 
 FORMAT_VERSION = 1
+
+
+def process_rank_world() -> tuple[int, int]:
+    """(rank, world) of this process in a multi-process job.
+
+    The elastic fleet's env (``ZNICZ_TPU_ELASTIC_RANK`` /
+    ``ZNICZ_TPU_ELASTIC_WORLD``, set per worker by
+    ``resilience/elastic.py``) wins; an already-initialized
+    ``jax.distributed`` is the fallback (only consulted when jax is
+    ALREADY imported — rank discovery must never boot a backend);
+    single-process default is ``(0, 1)``."""
+    rank = os.environ.get("ZNICZ_TPU_ELASTIC_RANK")
+    if rank is not None:
+        return int(rank), int(os.environ.get("ZNICZ_TPU_ELASTIC_WORLD",
+                                             "1"))
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            if jax_mod.process_count() > 1:
+                return jax_mod.process_index(), jax_mod.process_count()
+        except Exception:  # noqa: BLE001 — uninitialized runtime
+            pass
+    return 0, 1
 
 
 class SnapshotCorruptError(ValueError):
@@ -295,7 +320,11 @@ def write_snapshot(path: str, arrays: dict, meta: dict,
     meta = {**meta, "checksum": content_checksum(arrays)}
 
     def _write_once() -> None:
-        tmp = path + ".tmp"
+        # pid-unique temp name: even if the rank-0 election is bypassed
+        # (mixed versions, operator error) two processes racing the same
+        # snapshot path can each publish atomically instead of tearing
+        # one shared temp file
+        tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "wb") as f:
                 np.savez_compressed(
@@ -348,6 +377,7 @@ class SnapshotterBase(Unit):
     def __init__(self, workflow=None, prefix: str = "wf",
                  directory: Optional[str] = None, interval: int = 1,
                  only_improved: bool = True, keep_all: bool = False,
+                 verify_timeout: float = 5.0,
                  **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.prefix = prefix
@@ -355,6 +385,17 @@ class SnapshotterBase(Unit):
         self.interval = int(interval)
         self.only_improved = only_improved
         self.keep_all = keep_all
+        #: multi-process election (ISSUE 9): how long a non-zero rank
+        #: waits for rank 0's snapshot to appear before degrading to a
+        #: warning (the fleet's ranks run the same replicated decision
+        #: logic, so they reach — and gate — the same epochs).  Keep it
+        #: at or below the fleet's SIGTERM ``term_grace``: a verifier
+        #: whose writer just died should warn and exit gracefully, not
+        #: out-wait its own kill
+        self.verify_timeout = float(verify_timeout)
+        #: verification outcomes on non-zero ranks, for tests/status
+        self.verified_ok = 0
+        self.verified_failed = 0
         self.target_workflow = None
         self.decision = None
         #: path of the most recent snapshot (reference: destination)
@@ -386,12 +427,70 @@ class SnapshotterToFile(SnapshotterBase):
     (reference: SnapshotterToFile; compression is npz-deflate instead of
     the reference's gz/bz2/xz-by-extension)."""
 
+    def _verify_published(self, path: str) -> bool:
+        """Non-zero-rank half of the snapshot election: poll for rank
+        0's file at ``path`` and checksum-verify it.  Degrades to a
+        warning on timeout or corruption — a verifier must never kill
+        the training run (rank 0 may have died; the fleet supervisor
+        owns that failure)."""
+        deadline = time.monotonic() + self.verify_timeout
+        while not os.path.exists(path):
+            if time.monotonic() >= deadline:
+                self.verified_failed += 1
+                self.warning(f"snapshot election: rank-0 snapshot {path} "
+                             f"did not appear within "
+                             f"{self.verify_timeout}s")
+                return False
+            time.sleep(0.05)
+        # rank 0 publishes atomically (os.replace), so an existing path
+        # is a complete file; a checksum failure is real corruption
+        if verify_snapshot(path):
+            self.verified_ok += 1
+            self.debug(f"snapshot election: verified {path}")
+            return True
+        self.verified_failed += 1
+        self.warning(f"snapshot election: {path} FAILED checksum "
+                     f"verification")
+        return False
+
+    def _sweep_stale_temps(self) -> None:
+        """Unlink ``<prefix>_*.npz.tmp.<pid>`` litter left by writers
+        that were SIGKILL'd mid-write (pid-unique temps are crash-safe
+        but not self-cleaning the way the old shared name was).  Only
+        temps whose owning pid is gone are removed — a live concurrent
+        writer keeps its file."""
+        import glob as _glob
+        for tmp in _glob.glob(os.path.join(
+                self.directory, f"{self.prefix}_*.npz.tmp.*")):
+            pid_text = tmp.rsplit(".", 1)[1]
+            if pid_text.isdigit() and int(pid_text) != os.getpid():
+                try:
+                    os.kill(int(pid_text), 0)    # raises if pid is gone
+                except ProcessLookupError:
+                    try:
+                        os.unlink(tmp)
+                        self.debug(f"swept stale snapshot temp {tmp}")
+                    except OSError:
+                        pass
+                except OSError:
+                    pass                         # EPERM: someone else's
+
     def export(self) -> None:
         w = self.target_workflow
+        rank, world = process_rank_world()
+        if rank != 0:
+            # rank-0-writes / all-ranks-verify: concurrent writers would
+            # race each other into torn files; every other rank instead
+            # verifies the published artifact so corruption is caught at
+            # save time on some rank, not at restore time after a crash
+            epoch = int(w.loader.epoch_number)
+            self._verify_published(self.snapshot_path(epoch))
+            return
         arrays, meta = collect_state(w)
         epoch = int(meta["loader"]["epoch_number"])
         path = self.snapshot_path(epoch)
         os.makedirs(self.directory, exist_ok=True)
+        self._sweep_stale_temps()
         try:
             write_snapshot(path, arrays, meta)
         except OSError as exc:
