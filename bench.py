@@ -690,6 +690,57 @@ def bench_serve(duration_s=4.0, clients=8, max_batch=32):
           compile_count=engine.compile_count, cpu=True)
 
 
+def bench_generate(slots=4, max_len=128, n_requests=16, max_new=24,
+                   n_layers=2, d=64, heads=4, ff=128, vocab=64):
+    """Generative serving scenario (ISSUE 10): seeded mixed-length
+    requests stream through the KV-cache continuous batcher (CPU — this
+    measures the decode plane's machinery) and the line reports
+    sustained tokens/sec with TTFT p50/p95 from the generate metrics.
+    Steady-state compile delta == 0 after warmup is asserted AFTER the
+    line lands — a broken zero-recompile contract must fail the
+    scenario loudly, not ride a JSON field nobody greps."""
+    import numpy as np
+
+    from znicz_tpu.parallel.transformer import init_params
+    from znicz_tpu.serve import ContinuousBatcher, KVDecoder
+
+    params = init_params(np.random.default_rng(7), n_layers, d, heads,
+                         ff, vocab)
+    decoder = KVDecoder(params, heads=heads, max_len=max_len,
+                        batch=slots)
+    decoder.warmup()
+    compiles_after_warmup = decoder.compile_count
+    batcher = ContinuousBatcher(decoder, max_queue=n_requests,
+                                default_timeout_s=120.0)
+    rng = np.random.default_rng(1)
+    t0 = time.perf_counter()
+    streams = []
+    for i in range(n_requests):
+        prompt = rng.integers(0, vocab,
+                              size=int(rng.integers(4, 32))).tolist()
+        streams.append(batcher.submit(
+            prompt, max_new_tokens=max_new, temperature=0.8, top_k=8,
+            seed=i))
+    total_tokens = sum(len(s.result(timeout_s=300)) for s in streams)
+    elapsed = time.perf_counter() - t0
+    batcher.stop()
+    snap = batcher.metrics.snapshot()
+    compile_delta = decoder.compile_count - compiles_after_warmup
+    _emit("generate_tokens_per_sec", total_tokens / elapsed,
+          unit="tokens/sec",
+          ttft_p50_ms=snap["ttft"]["p50_ms"],
+          ttft_p95_ms=snap["ttft"]["p95_ms"],
+          requests=n_requests, slots=slots,
+          completed=snap["completed"],
+          steady_state_compile_delta=compile_delta, cpu=True)
+    assert snap["completed"] == n_requests, \
+        (f"generate ledger broke: {snap['completed']} of {n_requests} "
+         f"requests completed ({snap})")
+    assert compile_delta == 0, \
+        (f"steady-state decode recompiled: {compiles_after_warmup} -> "
+         f"{decoder.compile_count}")
+
+
 def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
                          n_valid=2560, hidden=512, reps=2):
     """Input-pipeline scenario (ISSUE 4): sync vs prefetch=2 through the
@@ -1061,6 +1112,15 @@ def child_main(mode: str) -> None:
         _enable_compile_cache()
         bench_serve()
         return
+    if mode == "generate":
+        # generative-serving scenario: CPU by design (measures the
+        # KV-cache decode + continuous-batching machinery)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_generate()
+        return
     if mode == "metrics_overhead":
         # telemetry-plane scenario: CPU by design (measures the
         # observe instrumentation through the real run loop)
@@ -1204,8 +1264,8 @@ def main():
     # serving-plane / input-pipeline / metrics-overhead scenarios: their
     # own CPU children (independent of the chip pool), BEFORE the final
     # flagship re-emit so the driver's last-line contract is untouched
-    for extra_mode in ("serve", "pipeline", "metrics_overhead",
-                       "compile_latency"):
+    for extra_mode in ("serve", "generate", "pipeline",
+                       "metrics_overhead", "compile_latency"):
         # compile_latency's own legs each budget up to CPU_TIMEOUT (two
         # fresh-process probes + the AOT export leg) — its OUTER timeout
         # must exceed their sum or a slow-but-in-budget cold probe gets

@@ -307,6 +307,46 @@ def test_engine_failure_fails_the_batch_but_not_the_batcher():
         batcher.stop()
 
 
+def test_failed_request_ledger_closes_exactly():
+    """ISSUE 10 small fix: `errors` counts failed BATCHES; `failed`
+    counts failed REQUESTS (whatever the cause — engine error,
+    deadline, shutdown flush), so admitted == completed + failed holds
+    with equality, not >=."""
+    class Flaky(RecordingModel):
+        def __call__(self, x):
+            if float(np.asarray(x).ravel()[0]) < 0:
+                raise RuntimeError("poison batch")
+            return super().__call__(x)
+
+    engine = BatchEngine(Flaky(), max_batch=4)
+    batcher = MicroBatcher(engine, max_wait_ms=1.0)
+    try:
+        bad = batcher.submit(np.full((1, 3), -1.0, np.float32))
+        with pytest.raises(RuntimeError):
+            bad.result(timeout=10)      # rides its own poisoned batch
+        ok = batcher.submit(np.full((1, 3), 1.0, np.float32))
+        assert ok.result(timeout=10) is not None
+        # a deadline lapse is also a failed request in the ledger
+        busy = batcher.submit(np.full((1, 3), 2.0, np.float32))
+        doomed = None
+        engine.model.delay_s = 0.15
+        busy2 = batcher.submit(np.full((1, 3), 3.0, np.float32))
+        time.sleep(0.02)
+        doomed = batcher.submit(np.full((1, 3), 4.0, np.float32),
+                                timeout_s=0.05)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+        for f in (busy, busy2):
+            assert f.result(timeout=10) is not None
+    finally:
+        batcher.stop()
+    snap = batcher.metrics.snapshot()
+    assert snap["errors"] == 1          # one poisoned batch
+    assert snap["timed_out"] == 1
+    assert snap["failed"] == 2          # the poisoned + the timed out
+    assert snap["admitted"] == snap["completed"] + snap["failed"]
+
+
 # -- acceptance load test ----------------------------------------------------
 
 def test_load_concurrent_clients_coalesce_with_zero_recompiles():
@@ -601,12 +641,14 @@ def test_chaos_kill_mid_request_aot_boot_exact_terminal_responses(tmp_path):
     errs = sum(1 for kind in outcomes.values() if kind[0] == "error")
     assert errs >= 1 and oks >= 1
     snap = batcher.metrics.snapshot()
-    # ledger closes: every admitted chunk either completed or rode one
-    # of the 3 failed batches ("errors" counts BATCH failures); nothing
-    # timed out, nothing vanished in the drain
+    # ledger closes EXACTLY (ISSUE 10 small fix): "errors" counts the 3
+    # failed BATCHES; "failed" counts the REQUESTS that rode them, so
+    # admitted == completed + failed with no slack — nothing timed out,
+    # nothing vanished in the drain
     assert snap["errors"] == 3
-    failed_chunks = snap["admitted"] - snap["completed"]
-    assert failed_chunks >= snap["errors"]
+    assert snap["admitted"] == snap["completed"] + snap["failed"]
+    assert snap["failed"] == errs
+    assert snap["failed"] >= snap["errors"]
     assert snap["timed_out"] == 0
     assert snap["completed"] >= oks             # oversize requests chunk
     # THE satellite pin: chaos + drain never compiled anything

@@ -1,0 +1,155 @@
+"""Generative serving smoke for tools/t1.sh (ISSUE 10).
+
+Exports a tiny LM package (random transformer params + a charmap),
+then boots the real ``python -m znicz_tpu generate --serve`` CLI in a
+FRESH process (no in-process warmth to hide behind), streams one short
+generation over HTTP, and asserts:
+
+- the ndjson stream carries non-empty token lines and EXACTLY ONE
+  terminal ``done`` line (the stream contract the chaos drill pins);
+- ``GET /metrics`` shows the request completed and tokens counted;
+- ``GET /metrics.prom`` exposes the ``znicz_generate_*`` metric
+  families (the observability satellite, end to end over the wire).
+
+jax-on-CPU by design (the caller pins JAX_PLATFORMS=cpu); the compile
+cache is pinned off — XLA's persistent cache intermittently segfaults
+single-process workers on this box (PR 9 note).  Every failure prints
+a ``generate_smoke:``-prefixed line and exits nonzero.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> "None":
+    print(f"generate_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def build_package(tmp: str) -> str:
+    import numpy as np
+
+    from znicz_tpu.parallel.transformer import init_params
+    from znicz_tpu.utils.export import export_lm
+
+    charmap = list("abcdefghijklmnopqrstuvwxyz .,!?")
+    params = init_params(np.random.default_rng(23), 2, 32, 4, 64,
+                         len(charmap))
+    pkg = os.path.join(tmp, "lm_smoke.npz")
+    export_lm(params, pkg, heads=4, charmap=charmap, name="smoke_lm")
+    return pkg
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def scrape(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="znicz_generate_smoke_")
+    proc = None
+    try:
+        pkg = build_package(tmp)
+        port = free_port()
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   ZNICZ_TPU_COMPILE_CACHE="off")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "generate", pkg,
+             "--serve", "--port", str(port), "--slots", "2",
+             "--max-len", "64"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 120
+        while True:
+            if proc.poll() is not None:
+                out = (proc.stdout.read() or "")[-2000:]
+                fail(f"server exited rc={proc.returncode} before "
+                     f"healthy: {out}")
+            try:
+                if json.loads(scrape(f"{base}/healthz"))["status"] == \
+                        "ok":
+                    break
+            except (urllib.error.URLError, OSError,
+                    json.JSONDecodeError):
+                pass
+            if time.monotonic() > deadline:
+                fail("server never became healthy within 120s")
+            time.sleep(0.25)
+
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"prompt": "hello world", "max_tokens": 8,
+                             "temperature": 0.8, "top_k": 5,
+                             "seed": 7}).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=60) as r:
+            if r.headers["Content-Type"] != "application/x-ndjson":
+                fail(f"unexpected content type "
+                     f"{r.headers['Content-Type']!r}")
+            for raw in r:
+                lines.append(json.loads(raw))
+        tokens = [ln for ln in lines if "token" in ln]
+        terminals = [ln for ln in lines if ln.get("done")]
+        if len(tokens) != 8:
+            fail(f"wanted 8 streamed tokens, got {len(tokens)}: {lines}")
+        if not all("text" in ln for ln in tokens):
+            fail(f"token lines missing charmap text: {tokens[:3]}")
+        if len(terminals) != 1 or terminals[0].get("reason") != \
+                "length" or lines[-1] is not terminals[0]:
+            fail(f"stream must end with exactly one done line: {lines}")
+
+        snap = json.loads(scrape(f"{base}/metrics"))
+        gen = snap.get("generate", {})
+        if gen.get("completed") != 1 or gen.get("tokens") != 8:
+            fail(f"metrics did not count the generation: {gen}")
+        if snap.get("decoder", {}).get("prefill_count", 0) < 1:
+            fail(f"decoder stats missing prefill: {snap.get('decoder')}")
+
+        prom = scrape(f"{base}/metrics.prom").decode()
+        for family in ("znicz_generate_tokens_total",
+                       "znicz_generate_requests_total",
+                       "znicz_generate_ttft_seconds",
+                       "znicz_generate_active_slots"):
+            if family not in prom:
+                fail(f"{family} missing from /metrics.prom")
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            fail("server did not drain within 60s of SIGTERM")
+        if rc != 0:
+            fail(f"server exited rc={rc} on SIGTERM drain")
+        proc = None
+        print(f"generate_smoke: ok — streamed {len(tokens)} tokens, "
+              f"terminal line + metrics families verified")
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,16 @@ if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/aot_smoke.py; then
          "lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 10 smoke: generative serving — boot the real `generate --serve`
+# CLI from an exported LM package in a fresh process, stream a short
+# generation over HTTP (ndjson tokens + exactly one terminal line),
+# assert the znicz_generate_* metric families are live
+# (docs/SERVING.md "Generative serving")
+if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/generate_smoke.py; then
+    echo "tools/t1.sh: generative serving smoke FAILED (see" \
+         "generate_smoke lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 # ISSUE 9 smoke: elastic kill-and-resume — 2 CPU worker processes, the
 # snapshot writer SIGKILL'd at a seeded step, fleet resumes at world
 # size 1; asserts completion + >= 1 flight artifact + resumes counter

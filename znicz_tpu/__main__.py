@@ -5,6 +5,8 @@ Usage:
     python -m znicz_tpu <workflow.py> [config.py ...] [options]
     python -m znicz_tpu forge {list,upload,fetch} ...
     python -m znicz_tpu serve <package.npz> [options]
+    python -m znicz_tpu generate <lm_package.npz> [--prompt TEXT |
+                                  --serve --port N --slots B] [options]
     python -m znicz_tpu aot <package.npz> [--max-batch N] [-o out.npz]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
     python -m znicz_tpu flight <flight_artifact.json> [--json]
@@ -206,6 +208,13 @@ def main(argv=None) -> int:
         from znicz_tpu.serve.server import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "generate":
+        # the generative serving plane (ISSUE 10): KV-cache incremental
+        # decode + continuous batching over an LM package — one-shot
+        # stdout generation or a streaming POST /generate server
+        from znicz_tpu.serve.server import generate_main
+
+        return generate_main(argv[1:])
     if argv and argv[0] == "aot":
         # compile-latency plane (ISSUE 7): embed ahead-of-time serving
         # executables into a forward package so `serve` boots with zero

@@ -74,5 +74,20 @@ def build(max_epochs: int = 3, seq_len: int = 32, minibatch_size: int = 16,
 
 
 def run(load, main):
-    load(build)
+    w, _ = load(build)
     main()
+    # generative serving handoff (ISSUE 10): with
+    # `-o root.common.engine.lm_export=path.npz` the trained params +
+    # corpus charmap land as an LM package `python -m znicz_tpu
+    # generate` boots directly — train and serve share one weight set
+    from znicz_tpu.core.config import root
+    path = str(root.common.engine.get("lm_export", "") or "")
+    if path:
+        # multi-process runs: only rank 0 writes (every rank executes
+        # this epilogue; concurrent writers would race the package the
+        # way pre-PR-9 snapshot temps did — and rank!=0 cannot
+        # device_get non-addressable shards anyway)
+        from znicz_tpu.snapshotter import process_rank_world
+        if process_rank_world()[0] == 0:
+            w.step.export_lm(path)
+            print(f"char_lm: exported LM package -> {path}")

@@ -326,6 +326,38 @@ _REMAT_POLICIES = {
 }
 
 
+def _forward_hidden(ps, tokens, heads_local, causal, use_flash,
+                    interp, cdt, remat: bool = False,
+                    use_ring_flash: bool = False,
+                    moe_aux_weight: float = 0.0,
+                    moe_top_k: int = 1,
+                    remat_policy: str | None = None,
+                    moe_zloss_weight: float = 0.0):
+    """Embedding + block stack — the ONE pre-head forward body, shared
+    by the CE loss (:func:`_forward_ce`) and the full-pass logits oracle
+    (:func:`make_logits_fn`, the generative serving plane's correctness
+    anchor).  Returns ``(x, aux_term, ps_cast)`` — the hidden states,
+    the summed MoE regularizer term, and the compute-dtype-cast params
+    (so the caller's head matmul uses the same precision policy)."""
+    ps = jax.tree.map(lambda w: w.astype(cdt), ps)
+    x = ps["emb"][tokens]                         # (b_l, t_l, d)
+    blk = _block
+    if remat or remat_policy:
+        pol = _REMAT_POLICIES[remat_policy] if remat_policy else None
+        blk = jax.checkpoint(
+            _block, policy=pol,
+            static_argnums=(2, 3, 4, 5, 6, 7,
+                            8, 9))  # type: ignore[assignment]
+    # regularizer weights apply inside _block (per-block pre-weighted)
+    aux_term = jnp.zeros((), jnp.float32)
+    for p in ps["blocks"]:
+        x, aux = blk(x, p, heads_local, causal, use_flash, interp,
+                     use_ring_flash, moe_top_k, moe_aux_weight,
+                     moe_zloss_weight)
+        aux_term = aux_term + aux
+    return x, aux_term, ps
+
+
 def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 interp, cdt, remat: bool = False,
                 loss_chunks: int | None = None,
@@ -343,22 +375,11 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     MoE blocks' summed load-balance aux into the loss (local-mean
     convention, same psum as the CE term; PADDED rows do count toward
     the routing statistics — the aux is a regularizer, not a metric)."""
-    ps = jax.tree.map(lambda w: w.astype(cdt), ps)
-    x = ps["emb"][tokens]                         # (b_l, t_l, d)
-    blk = _block
-    if remat or remat_policy:
-        pol = _REMAT_POLICIES[remat_policy] if remat_policy else None
-        blk = jax.checkpoint(
-            _block, policy=pol,
-            static_argnums=(2, 3, 4, 5, 6, 7,
-                            8, 9))  # type: ignore[assignment]
-    # regularizer weights apply inside _block (per-block pre-weighted)
-    aux_term = jnp.zeros((), jnp.float32)
-    for p in ps["blocks"]:
-        x, aux = blk(x, p, heads_local, causal, use_flash, interp,
-                     use_ring_flash, moe_top_k, moe_aux_weight,
-                     moe_zloss_weight)
-        aux_term = aux_term + aux
+    x, aux_term, ps = _forward_hidden(
+        ps, tokens, heads_local, causal, use_flash, interp, cdt,
+        remat=remat, use_ring_flash=use_ring_flash,
+        moe_aux_weight=moe_aux_weight, moe_top_k=moe_top_k,
+        remat_policy=remat_policy, moe_zloss_weight=moe_zloss_weight)
     b_l, t_l = labels.shape
     mvec = mask[:, None].astype(jnp.float32) if mask is not None else None
     # either path yields the LOCAL weighted nll sum; normalization below
@@ -572,6 +593,42 @@ def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
         ((P("data"),) if masked else ())
     fn = shard_map(local_eval, mesh=mesh, in_specs=in_specs,
                    out_specs=P())
+    return jax.jit(fn)
+
+
+def make_logits_fn(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
+                   vocab: int, causal: bool = True, compute_dtype=None,
+                   n_experts: int | None = None, moe_top_k: int = 1):
+    """-> jitted ``logits(params, tokens) -> (b, t, vocab)`` f32 — the
+    full forward pass through the SAME ``_forward_hidden`` body the
+    train/eval steps use, with the LM head applied per position instead
+    of the CE reduction.  This is the generative serving plane's
+    correctness oracle: ``serve/kvcache.py`` pins greedy KV-cache
+    incremental decode against exactly this function (ISSUE 10), so any
+    drift between training numerics and the decode path fails a test
+    instead of degrading generations silently.
+
+    The head must be replicated (``head_sharded`` has no logits form —
+    the vocab-sharded CE never materializes full-vocab rows by design);
+    callers wanting Megatron CE keep using :func:`make_eval_loss`."""
+    heads_local = _check_tp(mesh, heads, d, ff, None, n_experts)
+    cdt = _default_compute_dtype(compute_dtype)
+    from znicz_tpu.core.config import root as root_cfg
+    interp = bool(root_cfg.common.engine.get("pallas_interpret", False))
+    use_flash = _flash_eligible(mesh, interp)
+    use_ring_flash = _ring_flash_eligible(mesh, interp)
+
+    def local_logits(params, tokens):
+        x, _aux, ps = _forward_hidden(
+            params, tokens, heads_local, causal, use_flash, interp, cdt,
+            use_ring_flash=use_ring_flash, moe_top_k=moe_top_k)
+        return (x @ ps["head"]).astype(jnp.float32)
+
+    specs = param_specs(n_layers, False, moe=bool(n_experts))
+    batch_spec = P("data", "seq")
+    fn = shard_map(local_logits, mesh=mesh,
+                   in_specs=(specs, batch_spec),
+                   out_specs=batch_spec)
     return jax.jit(fn)
 
 

@@ -11,6 +11,10 @@ site                 hook location
 ``snapshot.write``   ``snapshotter.write_snapshot``, before the atomic
                      publish (context: ``path``)
 ``serve.run``        ``serve/engine.py`` ``BatchEngine.run`` entry
+``generate.step``    ``serve/continuous.py`` decode loop, once per
+                     batched decode step (context: ``batcher``) — a
+                     crash fails every ACTIVE stream with its terminal
+                     error sentinel and the worker keeps serving
 ``pipeline.fetch``   ``pipeline/prefetcher.py`` worker loop, once per
                      prefetched batch (context: ``loader``, ``batch``);
                      a crash here re-raises on the consumer — the

@@ -14,12 +14,19 @@ optimizer cost from replica count.
 """
 
 from znicz_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from znicz_tpu.serve.continuous import (ContinuousBatcher, GenerationError,
+                                        TokenStream)
 from znicz_tpu.serve.engine import BatchEngine, bucket_sizes, load_backend
-from znicz_tpu.serve.metrics import LatencyHistogram, ServingMetrics
-from znicz_tpu.serve.server import ServeServer, serve_main
+from znicz_tpu.serve.kvcache import KVDecoder, TokenSampler
+from znicz_tpu.serve.metrics import (GenerateMetrics, LatencyHistogram,
+                                     ServingMetrics)
+from znicz_tpu.serve.server import (GenerateServer, ServeServer,
+                                    generate_main, serve_main)
 
 __all__ = [
-    "BatchEngine", "DeadlineExceeded", "LatencyHistogram", "MicroBatcher",
-    "QueueFull", "ServeServer", "ServingMetrics", "bucket_sizes",
-    "load_backend", "serve_main",
+    "BatchEngine", "ContinuousBatcher", "DeadlineExceeded",
+    "GenerateMetrics", "GenerateServer", "GenerationError", "KVDecoder",
+    "LatencyHistogram", "MicroBatcher", "QueueFull", "ServeServer",
+    "ServingMetrics", "TokenSampler", "TokenStream", "bucket_sizes",
+    "generate_main", "load_backend", "serve_main",
 ]

@@ -154,6 +154,10 @@ class MicroBatcher(Logger):
     def _fail(self, req: _Request, exc: Exception) -> None:
         if not req.failed:
             req.failed = True
+            # the ONE place requests terminally fail — counted per
+            # REQUEST (not per chunk/batch), so the admission ledger
+            # closes exactly: admitted == completed + failed
+            self.metrics.on_request_failed()
             try:
                 req.future.set_exception(exc)
             except Exception:   # client cancelled the future: gone, fine
@@ -245,7 +249,14 @@ class MicroBatcher(Logger):
                 try:
                     req.future.set_result(out)
                 except Exception:   # cancelled mid-service: the worker
-                    continue        # must outlive any client's Future
+                    # must outlive any client's Future — and the ledger
+                    # must still close: a cancelled request reached its
+                    # terminal state (the client walked away), so it
+                    # counts failed, keeping admitted == completed +
+                    # failed exact
+                    req.failed = True
+                    self.metrics.on_request_failed()
+                    continue
                 self.metrics.on_complete(now - req.t_submit)
 
     def _loop(self) -> None:

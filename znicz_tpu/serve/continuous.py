@@ -1,0 +1,429 @@
+"""Continuous batching for generative decode — the admission half of
+the generative serving plane (ISSUE 10).
+
+``MicroBatcher`` drains whole batches: every request in a batch enters
+and leaves together, which is right for one-shot forward passes and
+wrong for autoregressive traffic (a 200-token generation would hold a
+4-token one hostage).  The continuous batcher instead keeps ONE decode
+batch running forever over a fixed-width *slot map*: every decode step
+advances all occupied slots by one token, finished requests free their
+slot mid-flight, and newly admitted requests prefill and join the very
+next step — no drain, no stragglers, the vLLM/Orca scheduling shape on
+top of :class:`~znicz_tpu.serve.kvcache.KVDecoder`'s bucketed cache.
+
+Contract (the serve plane's invariant, extended to streams): **every
+admitted request gets exactly one terminal event** — ``done`` after its
+tokens, or an error sentinel — never silence, never a duplicate:
+
+- **backpressure**: a full wait queue rejects at ``submit`` with the
+  serve plane's :class:`~znicz_tpu.serve.batcher.QueueFull` (HTTP 503);
+- **deadlines**: a request whose deadline lapses (queued OR
+  mid-generation) gets a terminal error sentinel naming the deadline;
+- **abort**: ``TokenStream.cancel()`` frees the slot at the next step
+  and counts the request abandoned;
+- **chaos**: a crash inside the decode loop (fault site
+  ``generate.step``, or a real engine failure) fails every ACTIVE
+  stream with the error sentinel and keeps the worker serving — queued
+  requests still get their turn;
+- **graceful drain**: ``stop(drain=True)`` rejects new arrivals but
+  decodes everything admitted to completion.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.resilience.faults import fault_hook
+from znicz_tpu.serve.batcher import QueueFull
+from znicz_tpu.serve.kvcache import KVDecoder, TokenSampler
+from znicz_tpu.serve.metrics import GenerateMetrics
+
+
+class GenerationError(RuntimeError):
+    """Terminal error sentinel carried by a :class:`TokenStream`."""
+
+
+class TokenStream:
+    """Client handle for one generation: a bounded-unbounded event
+    queue the batcher worker feeds.  Events are plain dicts —
+    ``{"token": id}`` per token, then exactly one terminal event:
+    ``{"done": True, "reason": ...}`` or ``{"error": msg, "done":
+    True}`` — the same shapes ``POST /generate`` streams as ndjson.
+    """
+
+    def __init__(self, prompt_len: int, max_new_tokens: int) -> None:
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        self.tokens: list = []
+        self.t_submit = time.monotonic()
+        self.ttft_s: float | None = None
+        #: batcher step counter when the first/last token landed — the
+        #: continuous-join pin reads these (a late joiner must finish at
+        #: a LOWER step count than a long early request)
+        self.first_token_step: int | None = None
+        self.finish_step: int | None = None
+        self._events: queue.Queue = queue.Queue()
+        self._terminal: dict | None = None
+        self._cancelled = threading.Event()
+
+    # -- batcher side --------------------------------------------------------
+    def _push_token(self, token: int) -> None:
+        self.tokens.append(token)
+        self._events.put({"token": int(token)})
+
+    def _push_terminal(self, event: dict) -> None:
+        self._terminal = event
+        self._events.put(event)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    # -- client side ---------------------------------------------------------
+    def cancel(self) -> None:
+        """Ask the batcher to free this request's slot at the next
+        step; the stream still receives its terminal event (``reason:
+        "aborted"``)."""
+        self._cancelled.set()
+
+    def next_event(self, timeout: float | None = None) -> dict:
+        """Blocking pop of the next event; raises ``TimeoutError`` when
+        ``timeout`` lapses with nothing produced."""
+        try:
+            return self._events.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"no stream event within {timeout}s") from None
+
+    def __iter__(self):
+        """Yield token ids until the terminal event; a terminal error
+        sentinel raises :class:`GenerationError`."""
+        while True:
+            event = self._events.get()
+            if "error" in event:
+                raise GenerationError(event["error"])
+            if event.get("done"):
+                return
+            yield event["token"]
+
+    def result(self, timeout_s: float | None = None) -> list:
+        """Collect the full generation; raises on the error sentinel."""
+        deadline = None if timeout_s is None else \
+            time.monotonic() + timeout_s
+        while self._terminal is None or not self._events.empty():
+            remaining = None if deadline is None else \
+                max(0.001, deadline - time.monotonic())
+            event = self.next_event(timeout=remaining)
+            if "error" in event:
+                raise GenerationError(event["error"])
+            if event.get("done"):
+                return list(self.tokens)
+        if "error" in (self._terminal or {}):
+            raise GenerationError(self._terminal["error"])
+        return list(self.tokens)
+
+
+class _GenRequest:
+    __slots__ = ("stream", "prompt", "max_new", "sampler", "deadline",
+                 "pos", "next_token", "emitted", "finished")
+
+    def __init__(self, stream: TokenStream, prompt: np.ndarray,
+                 max_new: int, sampler: TokenSampler,
+                 deadline: float | None) -> None:
+        self.stream = stream
+        self.prompt = prompt
+        self.max_new = max_new
+        self.sampler = sampler
+        self.deadline = deadline            # monotonic stamp or None
+        self.pos = 0                        # next cache row to write
+        self.next_token = 0                 # token to feed next step
+        self.emitted = 0
+        self.finished = False
+
+    @property
+    def total_budget(self) -> int:
+        return len(self.prompt) + self.max_new
+
+
+class ContinuousBatcher(Logger):
+    """Run a :class:`KVDecoder`'s batched decode loop with per-step
+    slot admission and retirement.
+
+    ``decoder.batch`` is the slot width; ``max_queue`` bounds requests
+    waiting for a slot (admission beyond it fails fast with
+    :class:`QueueFull`); ``default_timeout_s`` is the per-request
+    deadline when ``submit`` gets none.  The shared KV cache starts at
+    the smallest bucket covering the first admissions and grows (never
+    shrinks) to the bucket ceiling of what is admitted — each bucket's
+    programs compile once (or zero times after ``decoder.warmup()``),
+    and steady-state decode over mixed request lengths within a bucket
+    recompiles nothing.
+    """
+
+    def __init__(self, decoder: KVDecoder, max_queue: int = 64,
+                 default_timeout_s: float = 60.0,
+                 metrics: GenerateMetrics | None = None) -> None:
+        super().__init__()
+        self.decoder = decoder
+        self.slots: list = [None] * decoder.batch
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = default_timeout_s
+        self.metrics = metrics if metrics is not None else \
+            GenerateMetrics()
+        self.step_count = 0
+        self._kv = None
+        self._bucket = 0
+        self._pending: list = []
+        self._cond = threading.Condition()
+        self._closing = False
+        self._drain = True
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="continuous-batcher")
+        self._worker.start()
+
+    @property
+    def draining(self) -> bool:
+        return self._closing
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               timeout_s: float | None = None) -> TokenStream:
+        """Admit one generation; returns its :class:`TokenStream`.
+        Raises :class:`QueueFull` under backpressure or during drain,
+        ``ValueError`` on never-servable input (bad ids, budget beyond
+        the decoder's ``max_len``)."""
+        ids = np.asarray(prompt, np.int32).ravel()
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if ids.min() < 0 or ids.max() >= self.decoder.vocab:
+            raise ValueError(
+                f"token ids must be in [0, {self.decoder.vocab}); got "
+                f"range [{ids.min()}, {ids.max()}]")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        # never admissible — bad input, not backpressure (400, not 503)
+        self.decoder.bucket_for(ids.size + max_new_tokens)
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(f"timeout_s must be positive, got "
+                             f"{timeout_s}")
+        sampler = TokenSampler(seed=seed, temperature=temperature,
+                               top_k=top_k)
+        stream = TokenStream(ids.size, max_new_tokens)
+        deadline = None if timeout_s is None else \
+            time.monotonic() + timeout_s
+        req = _GenRequest(stream, ids, max_new_tokens, sampler, deadline)
+        with self._cond:
+            if self._closing:
+                self.metrics.on_reject()
+                raise QueueFull("generate batcher is draining")
+            if len(self._pending) >= self.max_queue:
+                self.metrics.on_reject()
+                raise QueueFull(f"generate queue full "
+                                f"({len(self._pending)}/{self.max_queue})")
+            self._pending.append(req)
+            self.metrics.on_admit()
+            self._cond.notify_all()
+        return stream
+
+    # -- worker side ---------------------------------------------------------
+    def _finish(self, req: _GenRequest, event: dict) -> None:
+        """THE single terminal-event path — exactly once per admitted
+        request, whatever the cause."""
+        if req.finished:
+            return
+        req.finished = True
+        req.stream.finish_step = self.step_count
+        req.stream._push_terminal(event)
+        if "error" in event:
+            self.metrics.on_failed()
+        elif event.get("reason") == "aborted":
+            self.metrics.on_abandoned()
+        else:
+            self.metrics.on_complete()
+
+    def _emit_token(self, req: _GenRequest, token: int) -> None:
+        if req.emitted == 0:
+            req.stream.ttft_s = time.monotonic() - req.stream.t_submit
+            req.stream.first_token_step = self.step_count
+            self.metrics.on_first_token(req.stream.ttft_s)
+        req.stream._push_token(token)
+        req.emitted += 1
+        self.metrics.on_tokens(1)
+
+    def _retire_if_done(self, req: _GenRequest, slot: int,
+                        now: float) -> bool:
+        """Post-emit terminal checks; True when the slot was freed."""
+        if req.emitted >= req.max_new:
+            self._finish(req, {"done": True, "reason": "length",
+                               "n_tokens": req.emitted})
+        elif req.stream.cancelled:
+            self._finish(req, {"done": True, "reason": "aborted",
+                               "n_tokens": req.emitted})
+        elif req.deadline is not None and now > req.deadline:
+            self._finish(req, {
+                "error": f"deadline exceeded after {req.emitted} tokens "
+                         f"({now - req.stream.t_submit:.3f}s)",
+                "done": True})
+        if req.finished:
+            self.slots[slot] = None
+            return True
+        return False
+
+    def _admit(self) -> None:
+        """Move pending requests into free slots: prefill the prompt,
+        splice the cache in, emit the first token (TTFT stops here).
+        Bucket growth happens before the splice so every live slot
+        rides one shared cache."""
+        while True:
+            with self._cond:
+                free = [i for i, s in enumerate(self.slots) if s is None]
+                if not free or not self._pending:
+                    return
+                req = self._pending.pop(0)
+            now = time.monotonic()
+            if req.stream.cancelled:
+                self._finish(req, {"done": True, "reason": "aborted",
+                                   "n_tokens": 0})
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, {
+                    "error": f"deadline exceeded after "
+                             f"{now - req.stream.t_submit:.3f}s in queue",
+                    "done": True})
+                continue
+            slot = free[0]
+            try:
+                need = self.decoder.bucket_for(max(
+                    [req.total_budget] +
+                    [r.total_budget for r in self.slots if r is not None]))
+                if self._kv is None:
+                    self._kv = self.decoder.alloc(need)
+                    self._bucket = need
+                elif need > self._bucket:
+                    self._kv = self.decoder.grow(self._kv, need)
+                    self._bucket = need
+                # prefill at the REQUEST's own bucket, not the shared
+                # one: a short prompt must not pay a long request's
+                # O(bucket^2) attention pass — adopt() grows the result
+                # to the shared bucket (zeros past the prompt, masked)
+                kv1, logits = self.decoder.prefill(
+                    req.prompt,
+                    bucket=self.decoder.bucket_for(req.total_budget))
+                self._kv = self.decoder.adopt(self._kv, kv1, slot)
+            except Exception as exc:  # noqa: BLE001 — this request only
+                self.error(f"prefill failed: {exc!r}")
+                self._finish(req, {"error": f"prefill failed: {exc!r}",
+                                   "done": True})
+                continue
+            req.pos = len(req.prompt)
+            self.slots[slot] = req
+            token = req.sampler.sample(logits)
+            req.next_token = token
+            self._emit_token(req, token)
+            self._retire_if_done(req, slot, time.monotonic())
+        # (unreachable)
+
+    def _step(self) -> None:
+        """One batched decode step over the occupied slots."""
+        # chaos hook (site "generate.step"): an injected crash here
+        # exercises the fail-all-active path and the stream error
+        # sentinel — the kill-mid-decode drill's anchor
+        fault_hook("generate.step", batcher=self)
+        pos = np.zeros(len(self.slots), np.int32)
+        tok = np.zeros(len(self.slots), np.int32)
+        for i, req in enumerate(self.slots):
+            if req is not None:
+                pos[i] = req.pos
+                tok[i] = req.next_token
+        self._kv, logits = self.decoder.decode(self._kv, pos, tok)
+        self.step_count += 1
+        now = time.monotonic()
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            # cancel/deadline between steps: retire without sampling
+            if req.stream.cancelled or (req.deadline is not None and
+                                        now > req.deadline):
+                self._retire_if_done(req, i, now)
+                continue
+            req.pos += 1
+            token = req.sampler.sample(logits[i])
+            req.next_token = token
+            self._emit_token(req, token)
+            self._retire_if_done(req, i, now)
+
+    def _fail_active(self, exc: Exception) -> None:
+        """A decode-loop crash poisons every in-flight stream (their
+        cache state is unknowable mid-step) — each gets its error
+        sentinel and the worker keeps serving the queue."""
+        for i, req in enumerate(self.slots):
+            if req is not None:
+                self._finish(req, {"error": f"decode failed: {exc!r}",
+                                   "done": True})
+                self.slots[i] = None
+
+    def _flush_pending(self, exc: Exception) -> None:
+        while True:
+            with self._cond:
+                if not self._pending:
+                    return
+                req = self._pending.pop(0)
+            self._finish(req, {"error": str(exc), "done": True})
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and \
+                        all(s is None for s in self.slots) and \
+                        not self._closing:
+                    self._cond.wait()
+                closing = self._closing
+                drain = self._drain
+            if closing and not drain:
+                self._fail_active(QueueFull("generate batcher shut down"))
+                self._flush_pending(QueueFull("generate batcher shut "
+                                              "down"))
+                return
+            try:
+                self._admit()
+                if any(s is not None for s in self.slots):
+                    self._step()
+            except Exception as exc:  # noqa: BLE001 — the worker must
+                # outlive anything one decode step can throw
+                self.error(f"decode step crashed: {exc!r}")
+                self._fail_active(exc)
+            with self._cond:
+                active = sum(s is not None for s in self.slots)
+                queued = len(self._pending)
+            self.metrics.on_slots(active, queued)
+            if closing and active == 0 and queued == 0:
+                return
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self, drain: bool = True,
+             join_timeout_s: float = 30.0) -> bool:
+        """Stop admitting.  ``drain=True`` decodes everything admitted
+        to completion; ``drain=False`` fails queued and active requests
+        loudly.  Returns True when the worker exited in time."""
+        with self._cond:
+            self._closing = True
+            self._drain = drain
+            self._cond.notify_all()
+        self._worker.join(timeout=join_timeout_s)
+        return not self._worker.is_alive()
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=True)

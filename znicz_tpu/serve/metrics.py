@@ -48,6 +48,39 @@ _M_QUEUE = _metrics.gauge("znicz_serve_queue_depth",
 _M_QPS = _metrics.gauge("znicz_serve_qps",
                         "completions/sec over the sliding window "
                         "(newest serving plane)")
+# ISSUE 10 small fix: `errors` counts failed BATCHES (one engine crash,
+# however many requests rode it); this counts failed REQUESTS, so the
+# admission ledger closes exactly: admitted == completed + failed
+_M_REQ_FAILED = _metrics.counter(
+    "znicz_serve_requests_failed_total",
+    "requests terminally failed (engine error, deadline, shutdown)")
+
+#: TTFT bucket upper bounds in milliseconds — generative serving's
+#: time-to-first-token spans an in-process prefill (~ms) to a deep
+#: admission queue under load
+TTFT_BUCKETS_MS = (
+    1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000)
+
+# generative plane mirrors (ISSUE 10): same newest-instance-wins gauge
+# convention as the serve mirrors above
+_M_GEN_REQUESTS = _metrics.counter(
+    "znicz_generate_requests_total", "generation requests by outcome",
+    labelnames=("event",))
+_M_GEN_TOKENS = _metrics.counter(
+    "znicz_generate_tokens_total", "tokens streamed to clients")
+_M_GEN_TTFT = _metrics.histogram(
+    "znicz_generate_ttft_seconds",
+    "time to first token (admit -> first sampled token)",
+    buckets=tuple(b / 1000.0 for b in TTFT_BUCKETS_MS))
+_M_GEN_SLOTS = _metrics.gauge(
+    "znicz_generate_active_slots",
+    "decode-batch slots generating right now (newest batcher)")
+_M_GEN_TPS = _metrics.gauge(
+    "znicz_generate_tokens_per_sec",
+    "tokens/sec over the sliding window (newest batcher)")
+_M_GEN_ABANDONED = _metrics.counter(
+    "znicz_generate_abandoned_total",
+    "requests abandoned by the client (cancel / disconnect)")
 
 
 class LatencyHistogram:
@@ -126,6 +159,8 @@ class ServingMetrics:
         self.timed_out = 0         # deadline expired before service
         self.completed = 0
         self.errors = 0            # model/engine raised during service
+        self.failed = 0            # requests terminally failed (ledger:
+        #                            admitted == completed + failed)
         self.queue_depth = 0       # live gauge, maintained by the batcher
         self.batch_sizes: dict[int, int] = {}   # coalesced batch -> count
         self.latency = LatencyHistogram()
@@ -170,6 +205,16 @@ class ServingMetrics:
             self.errors += 1
         if _probe.enabled():
             _M_REQUESTS.labels(event="error").inc()
+
+    def on_request_failed(self) -> None:
+        """One REQUEST got a terminal error (any cause: engine failure,
+        deadline, non-drain shutdown) — the batcher calls this exactly
+        once per request, from the one place requests fail, so
+        ``admitted == completed + failed`` holds after a drain."""
+        with self._lock:
+            self.failed += 1
+        if _probe.enabled():
+            _M_REQ_FAILED.inc()
 
     def on_batch(self, batch_rows: int) -> None:
         with self._lock:
@@ -221,8 +266,132 @@ class ServingMetrics:
                 "timed_out": self.timed_out,
                 "completed": self.completed,
                 "errors": self.errors,
+                "failed": self.failed,
                 "queue_depth": self.queue_depth,
                 "batch_size_histogram": {
                     str(k): v for k, v in sorted(self.batch_sizes.items())},
                 "latency": self.latency.snapshot(),
+            }
+
+
+class GenerateMetrics:
+    """Thread-safe counters for one generative serving plane
+    (continuous batcher + ``POST /generate``), mirrored into the shared
+    registry as the ``znicz_generate_*`` family.
+
+    The admission ledger is exact by construction — every admitted
+    request reaches exactly one of ``completed`` / ``failed`` /
+    ``abandoned`` (the continuous batcher's single terminal-event
+    path), so chaos drills assert ``admitted == completed + failed +
+    abandoned`` with ``==``, not ``>=``.
+    """
+
+    #: sliding-window length for the tokens/sec figure
+    WINDOW_S = 10.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.admitted = 0
+        self.rejected = 0          # backpressure: queue-full fast failures
+        self.completed = 0         # streams that ended normally
+        self.failed = 0            # terminal error sentinel (incl. deadline)
+        self.abandoned = 0         # client cancelled / disconnected
+        self.tokens = 0
+        self.active_slots = 0
+        self.queue_depth = 0       # admitted, waiting for a slot
+        self.ttft = LatencyHistogram(TTFT_BUCKETS_MS)
+        self._recent: deque = deque()       # (stamp, n_tokens)
+        _M_GEN_TPS.set_function(self.tokens_per_sec)  # newest wins
+
+    # -- event hooks (called by the continuous batcher) ----------------------
+    def on_admit(self) -> None:
+        with self._lock:
+            self.admitted += 1
+            self.queue_depth += 1
+        if _probe.enabled():
+            _M_GEN_REQUESTS.labels(event="admitted").inc()
+
+    def on_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+        if _probe.enabled():
+            _M_GEN_REQUESTS.labels(event="rejected").inc()
+
+    def on_slots(self, active: int, queued: int) -> None:
+        with self._lock:
+            self.active_slots = active
+            self.queue_depth = queued
+        if _probe.enabled():
+            _M_GEN_SLOTS.set(active)
+
+    def on_first_token(self, ttft_s: float) -> None:
+        with self._lock:
+            self.ttft.record(ttft_s)
+        if _probe.enabled():
+            _M_GEN_TTFT.observe(ttft_s)
+
+    def on_tokens(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.tokens += n
+            self._recent.append((now, n))
+            cutoff = now - self.WINDOW_S
+            while self._recent and self._recent[0][0] < cutoff:
+                self._recent.popleft()
+        if _probe.enabled():
+            _M_GEN_TOKENS.inc(n)
+
+    def on_complete(self) -> None:
+        with self._lock:
+            self.completed += 1
+        if _probe.enabled():
+            _M_GEN_REQUESTS.labels(event="completed").inc()
+
+    def on_failed(self) -> None:
+        with self._lock:
+            self.failed += 1
+        if _probe.enabled():
+            _M_GEN_REQUESTS.labels(event="failed").inc()
+
+    def on_abandoned(self) -> None:
+        with self._lock:
+            self.abandoned += 1
+        if _probe.enabled():
+            _M_GEN_ABANDONED.inc()
+            _M_GEN_REQUESTS.labels(event="abandoned").inc()
+
+    # -- export -------------------------------------------------------------
+    def tokens_per_sec(self) -> float:
+        """Streamed tokens/sec over the sliding window (since-start
+        average while the window is still filling)."""
+        with self._lock:
+            return self._tps_locked(time.monotonic())
+
+    def _tps_locked(self, now: float) -> float:
+        elapsed = now - self.started_at
+        if elapsed <= 0:
+            return 0.0
+        if elapsed < self.WINDOW_S:
+            return self.tokens / elapsed
+        cutoff = now - self.WINDOW_S
+        while self._recent and self._recent[0][0] < cutoff:
+            self._recent.popleft()
+        return sum(n for _, n in self._recent) / self.WINDOW_S
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "uptime_s": round(now - self.started_at, 3),
+                "tokens_per_sec": round(self._tps_locked(now), 3),
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "completed": self.completed,
+                "failed": self.failed,
+                "abandoned": self.abandoned,
+                "tokens": self.tokens,
+                "active_slots": self.active_slots,
+                "queue_depth": self.queue_depth,
+                "ttft": self.ttft.snapshot(),
             }

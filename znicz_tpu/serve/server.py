@@ -39,6 +39,30 @@ from znicz_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from znicz_tpu.serve.engine import BatchEngine, load_backend
 
 
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared HTTP scaffolding for both serving planes: silent access
+    log, one JSON reply helper, one healthz shape — the predict and
+    generate front ends must never drift on the envelope load balancers
+    and scrapers read."""
+
+    def log_message(self, *args):
+        pass
+
+    def _reply(self, code: int, doc: dict, headers=()) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_healthz(self, draining: bool) -> None:
+        self._reply(503 if draining else 200,
+                    {"status": "draining" if draining else "ok"})
+
+
 class ServeServer(Logger):
     """The assembled serving plane: engine + batcher + HTTP."""
 
@@ -83,28 +107,12 @@ class ServeServer(Logger):
     def start(self) -> int:
         plane = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
-            def _reply(self, code: int, doc: dict, headers=()) -> None:
-                body = json.dumps(doc).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in headers:
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(_JsonHandler):
             def do_GET(self):
                 if self.path.startswith("/metrics"):
                     self._reply(200, plane.metrics_snapshot())
                 elif self.path.startswith("/healthz"):
-                    draining = plane.batcher.draining
-                    self._reply(503 if draining else 200,
-                                {"status": "draining" if draining
-                                 else "ok"})
+                    self._reply_healthz(plane.batcher.draining)
                 else:
                     self._reply(200, plane.meta_snapshot())
 
@@ -167,7 +175,369 @@ class ServeServer(Logger):
                          " leaving the engine open for the worker")
 
 
+# -- generative serving plane (ISSUE 10) -------------------------------------
+
+def encode_chars(text: str, charmap) -> list:
+    """THE charmap text encoder (id <- character), shared by the HTTP
+    front end and the CLI so out-of-vocab handling cannot drift: every
+    character must be in the model's vocab — unknown characters fail
+    loudly instead of aliasing to id 0."""
+    stoi = {c: i for i, c in enumerate(charmap)}
+    missing = sorted({c for c in text if c not in stoi})
+    if missing:
+        raise ValueError(f"prompt contains characters outside the "
+                         f"model vocab: {missing[:8]!r}")
+    return [stoi[c] for c in text]
+
+class GenerateServer(Logger):
+    """The assembled generative plane: KV-cache decoder + continuous
+    batcher + streaming HTTP.
+
+    ::
+
+        POST /generate  {"prompt": "text"} | {"tokens": [ids]},
+                        "max_tokens": 32, "temperature": 0.0,
+                        "top_k": 0, "seed": 0, "timeout_s": 60,
+                        "stream": true
+            -> 200 ndjson stream: {"token": id[, "text": "c"]} per
+               token, then EXACTLY ONE terminal line — {"done": true,
+               "reason": "length", "n_tokens": N} or the error sentinel
+               {"error": "...", "done": true} (a stream NEVER just goes
+               quiet — the chaos drill pins this)
+            |  200 single JSON document with "stream": false
+            |  400 bad input | 503 queue full | 504 deadline (non-
+               stream mode; streamed deadlines arrive as the sentinel)
+        GET  /metrics       -> {"generate": ..., "decoder": ...}
+        GET  /metrics.prom  -> process registry, Prometheus text
+        GET  /healthz       -> 200 ok | 503 draining
+        GET  /              -> model metadata
+
+    ``charmap`` (id -> character, from the LM package) enables text
+    prompts and per-token ``"text"`` fields; tokens-only models speak
+    raw ids.
+    """
+
+    def __init__(self, batcher, charmap=None, port: int = 0,
+                 name: str = "lm") -> None:
+        super().__init__()
+        self.batcher = batcher
+        self.decoder = batcher.decoder
+        self.metrics = batcher.metrics
+        self.name = name
+        self.charmap = list(charmap) if charmap else None
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    # -- text codec ----------------------------------------------------------
+    def encode(self, text: str) -> list:
+        if self.charmap is None:
+            raise ValueError("this model has no charmap; send "
+                             "{\"tokens\": [...]} instead of a text "
+                             "prompt")
+        return encode_chars(text, self.charmap)
+
+    def decode_text(self, ids) -> str:
+        if self.charmap is None:
+            return ""
+        return "".join(self.charmap[i] for i in ids
+                       if 0 <= i < len(self.charmap))
+
+    # -- payloads ------------------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        return {"generate": self.metrics.snapshot(),
+                "decoder": self.decoder.stats()}
+
+    def meta_snapshot(self) -> dict:
+        return {"model": {"name": self.name, "kind": "lm",
+                          "vocab": self.decoder.vocab,
+                          "charmap": self.charmap is not None},
+                "max_len": self.decoder.max_len,
+                "slots": self.decoder.batch,
+                "n_requests": self.metrics.snapshot()["admitted"]}
+
+    def _submit_doc(self, doc: dict):
+        """Parse one /generate body and admit it; returns the stream.
+        Raises ValueError (400) / QueueFull (503)."""
+        if "tokens" in doc:
+            ids = [int(t) for t in doc["tokens"]]
+        elif "prompt" in doc:
+            ids = self.encode(str(doc["prompt"]))
+        else:
+            raise ValueError('body needs "prompt" or "tokens"')
+        return self.batcher.submit(
+            ids,
+            max_new_tokens=int(doc.get("max_tokens", 32)),
+            temperature=float(doc.get("temperature", 0.0)),
+            top_k=int(doc.get("top_k", 0)),
+            seed=int(doc.get("seed", 0)),
+            timeout_s=doc.get("timeout_s"))
+
+    # -- HTTP ----------------------------------------------------------------
+    def start(self) -> int:
+        plane = self
+
+        class Handler(_JsonHandler):
+            def do_GET(self):
+                if self.path.startswith("/metrics.prom"):
+                    from znicz_tpu.observe import REGISTRY
+                    body = REGISTRY.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.startswith("/metrics"):
+                    self._reply(200, plane.metrics_snapshot())
+                elif self.path.startswith("/healthz"):
+                    self._reply_healthz(plane.batcher.draining)
+                else:
+                    self._reply(200, plane.meta_snapshot())
+
+            def _slack(self, timeout_s) -> float:
+                """How long to wait on the stream before declaring the
+                worker wedged: the request's own deadline (explicit, or
+                the batcher's configured default — NOT a hardcoded
+                constant a --timeout-s flag would silently undercut)
+                plus grace."""
+                return (timeout_s or plane.batcher.default_timeout_s
+                        or 60.0) + 30.0
+
+            def _stream_events(self, stream, timeout_s) -> None:
+                """ndjson relay: every event the batcher emits becomes
+                one flushed line; a client that hangs up cancels the
+                generation (abandoned-request accounting)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()      # no Content-Length: close-delimited
+                # terminal events are guaranteed; the slack only guards
+                # a wedged worker from pinning this handler thread
+                slack = self._slack(timeout_s)
+                while True:
+                    try:
+                        event = stream.next_event(timeout=slack)
+                    except TimeoutError:
+                        # the client gets a terminal error NOW; cancel
+                        # so a later-recovering worker frees the slot
+                        # instead of decoding for a gone client
+                        stream.cancel()
+                        event = {"error": "stream stalled (worker "
+                                 "unresponsive)", "done": True}
+                    if "token" in event and plane.charmap is not None:
+                        event = {**event, "text":
+                                 plane.decode_text([event["token"]])}
+                    try:
+                        self.wfile.write(
+                            (json.dumps(event) + "\n").encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        stream.cancel()     # client hung up: free the
+                        return              # slot, count it abandoned
+                    if event.get("done"):
+                        return
+
+            def do_POST(self):
+                if not self.path.startswith("/generate"):
+                    self._reply(404, {"error": "POST /generate"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n))
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                    stream = plane._submit_doc(doc)
+                except QueueFull as exc:
+                    self._reply(503, {"error": str(exc)},
+                                headers=(("Retry-After", "1"),))
+                    return
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
+                if doc.get("stream", True):
+                    self._stream_events(stream, doc.get("timeout_s"))
+                    return
+                from znicz_tpu.serve.continuous import GenerationError
+                try:
+                    ids = stream.result(
+                        timeout_s=self._slack(doc.get("timeout_s")))
+                except GenerationError as exc:
+                    code = 504 if "deadline" in str(exc) else 500
+                    self._reply(code, {"error": str(exc),
+                                       "n_tokens": len(stream.tokens)})
+                    return
+                except TimeoutError as exc:
+                    stream.cancel()     # free the slot for a client
+                    self._reply(500, {"error": str(exc)})  # that's gone
+                    return
+                self._reply(200, {"tokens": ids,
+                                  "text": plane.decode_text(ids),
+                                  "reason": "length",
+                                  "n_tokens": len(ids)})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="generate-http")
+        self._thread.start()
+        self.info(f"generating on http://127.0.0.1:{self.port}/ "
+                  f"({self.decoder.batch} slots, max_len "
+                  f"{self.decoder.max_len})")
+        return self.port
+
+    def stop(self, drain: bool = True) -> None:
+        """Same load-balancer-observable order as ``ServeServer``: the
+        batcher drains first (healthz says 503 draining, new /generate
+        admissions 503), then the listener closes."""
+        self.batcher.stop(drain=drain)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
 # -- CLI ---------------------------------------------------------------------
+
+def build_generate_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu generate",
+        description="generate tokens from an LM package — one-shot to "
+                    "stdout, or a streaming HTTP server with "
+                    "continuous batching")
+    p.add_argument("package", help="path to a utils/export.py LM "
+                                   "package (export_lm / char_lm "
+                                   "lm_export)")
+    p.add_argument("--prompt", default=None,
+                   help="text prompt (one-shot mode unless --serve)")
+    p.add_argument("--tokens", default=None,
+                   help="comma-separated token ids instead of --prompt")
+    p.add_argument("--max-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 samples (seeded, reproducible)")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="truncate sampling to the k most likely (0 = "
+                        "full vocab)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-len", type=int, default=256,
+                   help="cache-length ceiling (prompt + generation)")
+    p.add_argument("--serve", action="store_true",
+                   help="serve POST /generate with continuous batching "
+                        "instead of a one-shot generation")
+    p.add_argument("--port", type=int, default=8080,
+                   help="listen port (0 picks a free one)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode-batch width (concurrent generations)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="requests waiting for a slot; beyond it -> 503")
+    p.add_argument("--timeout-s", type=float, default=60.0,
+                   help="default per-request deadline")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-compiling the cache buckets")
+    p.add_argument("--smoke-test", action="store_true",
+                   help="start, stream one self-request, exit (CI "
+                        "probe)")
+    return p
+
+
+def _parse_prompt(args, charmap) -> list:
+    if args.tokens is not None:
+        return [int(t) for t in args.tokens.split(",") if t.strip()]
+    if args.prompt is None:
+        raise ValueError("need --prompt or --tokens")
+    if not charmap:
+        raise ValueError("this package has no charmap; use --tokens")
+    return encode_chars(args.prompt, charmap)
+
+
+def generate_main(argv) -> int:
+    from znicz_tpu.serve.continuous import ContinuousBatcher
+    from znicz_tpu.serve.kvcache import KVDecoder, TokenSampler
+    from znicz_tpu.utils.export import load_lm
+
+    args = build_generate_parser().parse_args(argv)
+    try:
+        params, meta = load_lm(args.package)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"generate: cannot load {args.package!r}: {exc}")
+        return 2
+    charmap = meta.get("charmap")
+    serve_mode = args.serve or args.smoke_test
+    if not serve_mode:
+        # one-shot: stream the generation to stdout as it decodes
+        try:
+            ids = _parse_prompt(args, charmap)
+            decoder = KVDecoder(params, heads=meta["heads"],
+                                max_len=args.max_len, batch=1)
+            sampler = TokenSampler(seed=args.seed,
+                                   temperature=args.temperature,
+                                   top_k=args.top_k)
+
+            def on_token(tok: int) -> None:
+                if charmap:
+                    print(charmap[tok], end="", flush=True)
+                else:
+                    print(tok, end=" ", flush=True)
+
+            out = decoder.generate(ids, args.max_tokens, sampler,
+                                   on_token=on_token)
+        except ValueError as exc:
+            print(f"generate: {exc}")
+            return 2
+        print()
+        print(json.dumps({"n_tokens": len(out),
+                          "prompt_tokens": len(ids),
+                          "decoder": decoder.stats()}),
+              file=__import__("sys").stderr)
+        return 0
+    decoder = KVDecoder(params, heads=meta["heads"],
+                        max_len=args.max_len, batch=args.slots)
+    if not args.no_warmup:
+        decoder.warmup()
+    batcher = ContinuousBatcher(decoder, max_queue=args.max_queue,
+                                default_timeout_s=args.timeout_s)
+    server = GenerateServer(batcher, charmap=charmap, port=args.port,
+                            name=meta.get("name", "lm"))
+    port = server.start()
+    if args.smoke_test:
+        import urllib.request
+
+        body = {"max_tokens": 8, "temperature": 0.0}
+        if charmap:
+            body["prompt"] = charmap[0]
+        else:
+            body["tokens"] = [0]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=60) as r:
+            for raw in r:
+                lines.append(json.loads(raw))
+        ok = len(lines) >= 2 and lines[-1].get("done") and \
+            all("token" in ln for ln in lines[:-1])
+        print(json.dumps({"smoke": "ok" if ok else "bad", "port": port,
+                          "events": len(lines),
+                          "metrics": server.metrics_snapshot()}))
+        server.stop()
+        return 0 if ok else 1
+    done = threading.Event()
+    import signal
+
+    prev = signal.signal(signal.SIGTERM, lambda *a: done.set())
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    print("generate: draining...")
+    server.stop()
+    return 0
+
 
 def build_serve_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(

@@ -197,6 +197,31 @@ class TransformerLMStep(AcceleratedUnit):
         self.minibatch_mse = float(jax.device_get(loss))
         self.minibatch_size = count
 
+    # -- serving handoff (ISSUE 10) -----------------------------------------
+    def export_lm(self, path: str) -> str:
+        """Package the trained params as a generative serving artifact
+        (``utils/export.py::export_lm``): weights + architecture +
+        the loader's charmap, bootable by ``python -m znicz_tpu
+        generate`` into the KV-cache decode plane.  The SAME params
+        that trained serve — the unified train/serve contract the serve
+        plane is built on."""
+        import jax
+
+        from znicz_tpu.utils.export import export_lm
+
+        if self._params is None:
+            raise ValueError("export_lm needs an initialized workflow "
+                             "(params live on device after xla_init)")
+        if self.n_experts:
+            raise ValueError("export_lm cannot package an MoE stack "
+                             "(KV-cache decode serves dense FFN only)")
+        params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
+                              self._params)
+        charmap = list(getattr(self.loader, "vocab", []) or []) or None
+        wf = getattr(self, "workflow", None)
+        return export_lm(params, path, heads=self.heads, charmap=charmap,
+                         name=getattr(wf, "name", None) or "char_lm")
+
     # -- snapshot support ---------------------------------------------------
     def state_dict(self) -> dict:
         import jax

@@ -88,6 +88,84 @@ def export_forward(workflow, path: str, use_ema: bool = False,
     return path
 
 
+# -- generative LM packages (ISSUE 10) ---------------------------------------
+
+#: schema tag for transformer LM packages (serve/kvcache.py consumers)
+LM_FORMAT = "znicz_tpu.lm/1"
+
+
+def export_lm(params, path: str, *, heads: int, charmap=None,
+              name: str = "lm") -> str:
+    """Package a ``parallel/transformer.py`` param pytree as a
+    generative serving artifact (.npz): flat weight arrays plus an
+    ``__lm__`` meta block carrying the architecture (layers/d/heads/ff/
+    vocab — everything :class:`~znicz_tpu.serve.kvcache.KVDecoder`
+    needs) and, for char LMs, the ``charmap`` (id -> character) so the
+    server can speak text on the wire.  ``heads`` is the one
+    architecture fact the shapes cannot reveal."""
+    vocab, d = (int(s) for s in np.shape(params["emb"]))
+    blocks = params["blocks"]
+    if any("ew1" in blk for blk in blocks):
+        raise ValueError("export_lm supports dense FFN stacks only "
+                         "(KV-cache decode does not serve MoE)")
+    ff = int(np.shape(blocks[0]["w1"])[1])
+    if d % int(heads):
+        raise ValueError(f"heads={heads} must divide d={d}")
+    if charmap is not None and len(charmap) != vocab:
+        raise ValueError(f"charmap has {len(charmap)} entries but the "
+                         f"embedding carries vocab {vocab}")
+    arrays = {"emb": np.asarray(params["emb"], np.float32),
+              "head": np.asarray(params["head"], np.float32)}
+    for i, blk in enumerate(blocks):
+        for key, arr in blk.items():
+            arrays[f"blocks.{i}.{key}"] = np.asarray(arr, np.float32)
+    meta = {"format": LM_FORMAT, "name": name, "n_layers": len(blocks),
+            "d": d, "heads": int(heads), "ff": ff, "vocab": vocab,
+            "charmap": list(charmap) if charmap is not None else None}
+    # pid-unique temp (the PR 9 snapshot lesson): two processes
+    # exporting to the same path must not tear a shared .tmp
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, __lm__=np.array(json.dumps(meta)),
+                            **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def load_lm(path: str):
+    """-> ``(params, meta)`` from an :func:`export_lm` package.  The
+    params come back as the numpy pytree ``KVDecoder`` (and
+    ``make_logits_fn``) consume; raises ``ValueError`` on a package
+    that is not an LM artifact (e.g. a ``forward`` package handed to
+    the wrong CLI)."""
+    with np.load(path, allow_pickle=False) as z:
+        if "__lm__" not in z:
+            raise ValueError(f"{path!r} is not an LM package (no __lm__ "
+                             "meta; `znicz_tpu serve` handles forward "
+                             "packages)")
+        meta = json.loads(str(z["__lm__"]))
+        if meta.get("format") != LM_FORMAT:
+            raise ValueError(f"unsupported LM package format "
+                             f"{meta.get('format')!r} (want {LM_FORMAT})")
+        blocks: list = [{} for _ in range(int(meta["n_layers"]))]
+        for key in z.files:
+            if key.startswith("blocks."):
+                _, idx, leaf = key.split(".", 2)
+                if not 0 <= int(idx) < len(blocks):
+                    # ValueError, not IndexError: the CLI's cannot-load
+                    # rc=2 path catches the former
+                    raise ValueError(
+                        f"{path!r} carries {key!r} but meta declares "
+                        f"only {len(blocks)} layer(s)")
+                blocks[int(idx)][leaf] = z[key]
+        params = {"emb": z["emb"], "head": z["head"], "blocks": blocks}
+    if any(not blk for blk in blocks):
+        raise ValueError(f"{path!r} is missing block arrays for "
+                         f"{sum(not b for b in blocks)} of "
+                         f"{len(blocks)} layers")
+    return params, meta
+
+
 # -- ahead-of-time serving artifacts (ISSUE 7) -------------------------------
 
 def aot_fingerprint() -> dict:
