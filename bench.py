@@ -829,6 +829,11 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
     load: it now also carries an attached watchtower (step-boundary
     registry sampling + the full five-rule SLO catalogue) so the <2%
     bound covers sampler + rule engine, not just probes + tracer.
+    ISSUE 11 raised it again: the instrumented arm additionally runs a
+    fleet MetricsExporter (the worker-side half of metric federation —
+    periodic registry render + atomic file rewrite, exactly what an
+    elastic rank pays under a supervising aggregator), so the bound
+    covers the federation plane's per-worker cost too.
 
     Protocol, forced by this box's load profile: scheduler theft on the
     shared sandbox swings individual runs ±10-40% (sampled runs sit at
@@ -852,11 +857,13 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
     flushes, so a violation still records the measurement but fails the
     scenario loudly (nonzero child exit)."""
     import statistics
+    import tempfile
     import time as _time
 
     from znicz_tpu import observe
     from znicz_tpu.core import prng
     from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.observe import federation as _fed
     from znicz_tpu.observe import watchtower as _wt
     from znicz_tpu.standard_workflow import StandardWorkflow
 
@@ -871,6 +878,9 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
                   "minibatch_size": minibatch, "spread": 2.5,
                   "noise": 1.0}
 
+    mx_path = os.path.join(tempfile.gettempdir(),
+                           f"znicz_bench_fleet_{os.getpid()}.json")
+
     def run_once(enabled):
         observe.set_enabled(enabled)
         prng.seed_all(7)
@@ -879,6 +889,7 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
             loader_name="synthetic_classifier", loader_config=loader_cfg,
             decision_config={"max_epochs": epochs})
         w.initialize(device=TPUDevice())
+        exporter = None
         if enabled:
             # ISSUE 6: the instrumented arm pays for the whole plane —
             # step-boundary sampling + the full rule catalogue evaluated
@@ -894,11 +905,16 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
                               _wt.pipeline_consumer_starvation):
                 tower.add_rule(make_rule())
             tower.attach(w)
+            # ISSUE 11: plus the worker-side federation exporter at the
+            # elastic supervisor's default cadence
+            exporter = _fed.start_metrics_export(mx_path, interval_s=1.0)
         t0 = _time.perf_counter()
         w.run()
         dt = _time.perf_counter() - t0
         hist = w.decision.metrics_history
         w.stop()
+        if exporter is not None:
+            exporter.stop()
         return (n_train + n_valid) * epochs / dt, hist
 
     try:
@@ -918,6 +934,8 @@ def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
             ratios.append(s / b)
     finally:
         observe.set_enabled(True)
+        with contextlib.suppress(OSError):
+            os.remove(mx_path)
     bare_sps = max(bare)
     inst_sps = max(inst)
     best_of_n_pct = (1.0 - inst_sps / bare_sps) * 100.0

@@ -584,10 +584,12 @@ def test_jsonl_unbounded_by_default(tmp_path):
 
 # -- flight recorder ---------------------------------------------------------
 
-#: the pinned artifact schema: a reader of flight/1 may rely on exactly
-#: these keys being present
+#: the pinned artifact schema: a reader of flight/2 may rely on exactly
+#: these keys being present ("planes" — registered live-subsystem
+#: snapshot providers — is the /1 -> /2 addition, ISSUE 11)
 FLIGHT_KEYS = {"schema", "reason", "ts", "iso", "host", "pid", "extra",
-               "spans", "timeseries", "metrics", "config", "log_tail"}
+               "spans", "timeseries", "metrics", "planes", "config",
+               "log_tail"}
 
 
 def test_flight_artifact_schema_pinned(tmp_path):
@@ -595,7 +597,7 @@ def test_flight_artifact_schema_pinned(tmp_path):
                        extra={"k": 1})
     assert os.path.basename(path).startswith("flight_")
     doc = flight.load(path)
-    assert doc["schema"] == "znicz_tpu.flight/1"
+    assert doc["schema"] == "znicz_tpu.flight/2"
     assert set(doc) == FLIGHT_KEYS
     assert doc["reason"] == "schema pin" and doc["extra"] == {"k": 1}
     assert doc["pid"] == os.getpid()

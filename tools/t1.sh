@@ -45,6 +45,17 @@ if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/generate_smoke.py; then
          "generate_smoke lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 11 smoke: fleet telemetry — boot 2 real generate workers with
+# rank env, aggregate their /metrics.prom into one rank-labeled fleet
+# view, assert a fleet rule evaluates over the merged series and the
+# merged Perfetto trace carries request phase spans from both ranks
+# (docs/OBSERVABILITY.md "Fleet telemetry"; ZNICZ_TPU_COMPILE_CACHE=off
+# per the PR 9 box note)
+if ! timeout -k 5 300 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py; then
+    echo "tools/t1.sh: fleet telemetry smoke FAILED (see fleet_smoke" \
+         "lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 # ISSUE 9 smoke: elastic kill-and-resume — 2 CPU worker processes, the
 # snapshot writer SIGKILL'd at a seeded step, fleet resumes at world
 # size 1; asserts completion + >= 1 flight artifact + resumes counter

@@ -9,6 +9,7 @@ Usage:
                                   --serve --port N --slots B] [options]
     python -m znicz_tpu aot <package.npz> [--max-batch N] [-o out.npz]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
+    python -m znicz_tpu trace --fleet -o <out.json> <src> [<src> ...]
     python -m znicz_tpu flight <flight_artifact.json> [--json]
     python -m znicz_tpu elastic --workers N --snap-dir D <workflow.py> ...
 
@@ -197,6 +198,20 @@ def main(argv=None) -> int:
     from znicz_tpu.resilience import faults as _faults
 
     _faults.install_from_env()
+    # fleet metric federation (ISSUE 11): an elastic supervisor asks its
+    # workers to publish rank-tagged registry snapshots beside the
+    # heartbeat files; the exporter covers every subcommand's registry
+    # (training workflows, serve, generate).  No env var = nothing.
+    import os as _os
+
+    _mx_path = _os.environ.get("ZNICZ_TPU_METRICS_EXPORT")
+    if _mx_path:
+        from znicz_tpu.observe.federation import start_metrics_export
+
+        start_metrics_export(
+            _mx_path,
+            interval_s=float(_os.environ.get(
+                "ZNICZ_TPU_METRICS_EXPORT_INTERVAL", "1.0")))
     if argv and argv[0] == "forge":
         site = apply_site_config()            # site may set the forge dir
         if site:
@@ -230,11 +245,20 @@ def main(argv=None) -> int:
 
         return flight.flight_main(argv[1:])
     if argv and argv[0] == "trace":
+        if "--fleet" in argv:
+            # fleet trace merge (ISSUE 11): align N workers' exported
+            # timelines (or live /trace.json endpoints) onto one clock
+            # — `znicz_tpu trace --fleet -o out.json SRC [SRC ...]`
+            from znicz_tpu.observe.federation import fleet_trace_main
+
+            return fleet_trace_main([a for a in argv[1:]
+                                     if a != "--fleet"])
         # observability shorthand: run the workflow, export its span
         # timeline — `znicz_tpu trace out.json workflow.py [cfg ...]`
         if len(argv) < 3:
             print("usage: znicz_tpu trace <out.json> <workflow.py> "
-                  "[config.py ...] [options]", file=sys.stderr)
+                  "[config.py ...] [options] | znicz_tpu trace --fleet "
+                  "-o out.json SRC [SRC ...]", file=sys.stderr)
             return 2
         return main(list(argv[2:]) + ["--trace", argv[1]])
     args = build_parser().parse_args(argv)

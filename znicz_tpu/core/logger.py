@@ -35,7 +35,9 @@ EVENT_LOGGER = "znicz_tpu.events"
 class JsonlHandler(logging.FileHandler):
     """One JSON object per record: ``{"ts", "level", "logger", "msg"}``
     plus an ``"event"``/``"args"`` pair when the record carries a
-    structured observe event (see :func:`event_log`).
+    structured observe event (see :func:`event_log`), plus ``"rank"``
+    inside an elastic fleet (``$ZNICZ_TPU_ELASTIC_RANK``) so merged
+    fleet logs stay attributable per worker.
 
     ``max_bytes > 0`` bounds the sink with a keep-1 rollover: when the
     next record would cross the limit, the live file is atomically
@@ -46,6 +48,16 @@ class JsonlHandler(logging.FileHandler):
 
     def __init__(self, path: str, max_bytes: int = 0) -> None:
         self.max_bytes = int(max_bytes)
+        # fleet rank tag (ISSUE 11): inside an elastic fleet every
+        # record carries the worker's rank, so N workers' JSONL streams
+        # merge into one attributable log.  Read once — the env is the
+        # per-process contract resilience/elastic.py sets at spawn
+        # (core must not import the resilience plane, which imports it)
+        rank = os.environ.get("ZNICZ_TPU_ELASTIC_RANK")
+        try:
+            self.rank = int(rank) if rank is not None else None
+        except ValueError:
+            self.rank = None
         super().__init__(path, mode="a", delay=True)
 
     def _rollover(self) -> None:
@@ -63,6 +75,8 @@ class JsonlHandler(logging.FileHandler):
                    "level": record.levelname,
                    "logger": record.name,
                    "msg": record.getMessage()}
+            if self.rank is not None:
+                doc["rank"] = self.rank
             event = getattr(record, "observe_event", None)
             if event is not None:
                 doc["event"] = event

@@ -8,8 +8,10 @@ the fixed instrumentation hooks the runtime calls (``probe``: per-step
 timing, recompile detection, cold-compile timing, staged-bytes
 accounting, resilience events), the watchtower (``watchtower.
 WATCHTOWER``: retained time-series ring + declarative SLO rules
-evaluated by the sampler), and the flight recorder (``flight``:
-atomic crash post-mortem artifacts).
+evaluated by the sampler), the flight recorder (``flight``:
+atomic crash post-mortem artifacts), and the fleet federation plane
+(``federation``: rank-labeled cross-process metric aggregation,
+``/fleet/*`` endpoints, merged distributed traces — ISSUE 11).
 
 Scrape surfaces: ``WebStatus`` serves ``GET /metrics`` (Prometheus
 text), ``GET /trace.json`` (ring dump) and ``GET /timeseries.json``
@@ -36,6 +38,11 @@ from znicz_tpu.observe.probe import (check_recompiles,
 from znicz_tpu.observe.watchtower import (WATCHTOWER, Rule,
                                           TimeSeriesRing, Watchtower)
 from znicz_tpu.observe import flight
+from znicz_tpu.observe import federation
+from znicz_tpu.observe.federation import (FleetAggregator,
+                                          MetricsExporter, merge_traces,
+                                          next_request_id,
+                                          start_metrics_export)
 
 __all__ = ["REGISTRY", "Registry", "counter", "gauge", "histogram",
            "quantile_from_buckets",
@@ -45,4 +52,5 @@ __all__ = ["REGISTRY", "Registry", "counter", "gauge", "histogram",
            "compile_observed", "time_compiles",
            "compile_cache_event", "compile_cache_stats",
            "WATCHTOWER", "Watchtower", "Rule", "TimeSeriesRing",
-           "flight"]
+           "flight", "federation", "FleetAggregator", "MetricsExporter",
+           "merge_traces", "next_request_id", "start_metrics_export"]

@@ -45,8 +45,12 @@ from znicz_tpu.observe import registry as _reg
 from znicz_tpu.observe import trace as _trace
 from znicz_tpu.observe import watchtower as _watchtower
 
-#: artifact schema identifier — pinned by tests/test_watchtower.py
-SCHEMA = "znicz_tpu.flight/1"
+#: artifact schema identifier — pinned by tests/test_watchtower.py.
+#: /2 added the top-level ``planes`` key (live-subsystem snapshots from
+#: registered providers: the serve/generate admission ledgers, the
+#: fleet aggregator's per-worker view); the viewer still reads /1
+SCHEMA = "znicz_tpu.flight/2"
+_READABLE_SCHEMAS = ("znicz_tpu.flight/1", SCHEMA)
 
 #: auto-dump configuration (process-global, mirrors the plane's other
 #: singletons); ``dir=None`` keeps auto_dump a no-op
@@ -63,15 +67,43 @@ def configure(dir: Optional[str] = None, last_spans: int = 256,
               min_interval_s: float = 1.0) -> None:
     """Opt in to automatic dumps: artifacts land in ``dir`` on every
     injected fault / NaN-guard trip / watchtower rule trip, at most one
-    per ``min_interval_s``.  ``configure()`` with no dir disables."""
+    per ``min_interval_s``.  ``configure()`` with no dir disables.
+    Reconfiguring resets the rate limiter: an explicit opt-in starts a
+    fresh window, so a dump made under the PREVIOUS config (possibly
+    with a tiny interval) cannot suppress the new config's first
+    artifact for up to its whole ``min_interval_s``."""
+    global _last_auto_dump
     _config.update(dir=dir, last_spans=int(last_spans),
                    last_samples=int(last_samples),
                    log_lines=int(log_lines),
                    min_interval_s=float(min_interval_s))
+    _last_auto_dump = None
 
 
 def configured() -> bool:
     return _config["dir"] is not None
+
+
+#: live-subsystem snapshot providers embedded into every artifact under
+#: ``planes`` (ISSUE 11): name -> zero-arg callable returning a JSON-able
+#: dict.  The continuous batcher registers its admission ledger here so
+#: a post-mortem can check ``admitted == completed+failed+abandoned``
+#: without a live scrape; the fleet aggregator registers each worker's
+#: last snapshot.  Newest registration per name wins (the registry-gauge
+#: convention); a raising provider degrades to an error string.
+_planes: dict = {}
+
+
+def register_plane(name: str, fn) -> None:
+    _planes[str(name)] = fn
+
+
+def unregister_plane(name: str, fn=None) -> None:
+    """Remove a provider — with ``fn`` given, only if it is still the
+    registered one (a torn-down batcher must not evict its
+    replacement)."""
+    if fn is None or _planes.get(str(name)) is fn:
+        _planes.pop(str(name), None)
 
 
 def _jsonable(value):
@@ -154,6 +186,12 @@ def build_artifact(reason: str, extra: Optional[dict] = None,
     ts_doc = tower.ring.to_dict(last_n=n_samples)
     ts_doc["summary"] = tower.ring.summary()
     ts_doc["rules"] = [r.snapshot() for r in tower.rules]
+    planes = {}
+    for name, fn in list(_planes.items()):
+        try:
+            planes[name] = _jsonable(fn())
+        except Exception as exc:  # noqa: BLE001 — a dead plane must
+            planes[name] = {"error": repr(exc)}   # not fail the dump
     now = time.time()
     return {
         "schema": SCHEMA,
@@ -166,6 +204,7 @@ def build_artifact(reason: str, extra: Optional[dict] = None,
         "spans": _trace.TRACER.tail(n_spans),
         "timeseries": ts_doc,
         "metrics": _reg.REGISTRY.snapshot(),
+        "planes": planes,
         "config": _config_fingerprint(),
         "log_tail": _log_tail(_config["log_lines"]),
     }
@@ -221,10 +260,10 @@ def load(path: str) -> dict:
     """Read + schema-check one artifact."""
     with open(path) as f:
         doc = json.load(f)
-    if doc.get("schema") != SCHEMA:
+    if doc.get("schema") not in _READABLE_SCHEMAS:
         raise ValueError(f"{path}: not a flight artifact "
                          f"(schema={doc.get('schema')!r}, "
-                         f"expected {SCHEMA!r})")
+                         f"expected one of {_READABLE_SCHEMAS})")
     return doc
 
 
@@ -270,6 +309,8 @@ def print_flight(doc: dict, out=None, span_rows: int = 20) -> None:
         w(f"  {key}: last={row['last']:g} min={row['min']:g} "
           f"mean={row['mean']:g} max={row['max']:g}{rate}\n")
     w(f"\nmetrics: {len(doc.get('metrics', {}))} registry families\n")
+    for name, plane in sorted((doc.get("planes") or {}).items()):
+        w(f"  plane {name}: {json.dumps(plane)[:200]}\n")
     tail = doc.get("log_tail", [])
     if tail:
         w(f"\nlog tail ({len(tail)} lines):\n")

@@ -104,19 +104,26 @@ class Tracer:
         return _Span(self, name, args or None)
 
     def complete(self, name: str, start: float, duration: float,
-                 args: Optional[dict] = None, **kw) -> None:
+                 args: Optional[dict] = None, tid: Optional[int] = None,
+                 **kw) -> None:
         """Record an already-timed span: ``start`` is a
         ``time.perf_counter()`` stamp, ``duration`` in seconds — the
         workflow run loop times deliveries once and feeds both the
         step-latency histogram and the trace from the same reads.
         ``args`` takes a PRE-BUILT (reusable) dict so the per-signal
         path allocates only the event tuple; kwargs remain for cold
-        callers."""
+        callers.  ``tid`` overrides the recorded thread id with a
+        synthetic track — the serving plane's per-request phase spans
+        (queue/prefill/decode/stream) share one
+        ``federation.request_track(rid)`` row so concurrent requests'
+        overlapping phases render as parallel tracks in Perfetto
+        instead of colliding on the worker thread's row."""
         if not self.enabled:
             return
         self._events.append(
             ("X", name, (start - self._origin) * 1e6, duration * 1e6,
-             threading.get_ident(), kw or args))
+             tid if tid is not None else threading.get_ident(),
+             kw or args))
 
     def instant(self, name: str, **args) -> None:
         """Point event (fault fired, recompile, restart, ...)."""
@@ -167,7 +174,13 @@ class Tracer:
         return [self._format_event(e, pid) for e in events[-n:]]
 
     def export_dict(self) -> dict:
-        """Chrome trace JSON document (``{"traceEvents": [...]}``)."""
+        """Chrome trace JSON document (``{"traceEvents": [...]}``).
+        Carries two fleet-merge anchors on top of the Chrome schema
+        (extra top-level keys are ignored by Perfetto): ``rank`` (the
+        elastic fleet env, None outside a fleet) and
+        ``origin_unix_ts`` — the wall-clock instant of this tracer's
+        ``ts == 0``, so ``federation.merge_traces`` can align N
+        workers' monotonic clocks onto one timeline."""
         pid = os.getpid()
         events = list(self._events)   # atomic snapshot of the ring
         tids = {}
@@ -180,7 +193,12 @@ class Tracer:
         for ident, tname in tids.items():
             out.append({"ph": "M", "pid": pid, "tid": ident,
                         "name": "thread_name", "args": {"name": tname}})
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        from znicz_tpu.observe.federation import fleet_rank
+
+        origin_unix = time.time() - (time.perf_counter() - self._origin)
+        return {"traceEvents": out, "displayTimeUnit": "ms",
+                "rank": fleet_rank(),
+                "origin_unix_ts": round(origin_unix, 6)}
 
     def export(self, path: str) -> int:
         """Write the Chrome-trace JSON to ``path``; returns the number

@@ -41,7 +41,20 @@ Worker environment contract (what a worker process finds):
 ``ZNICZ_TPU_FAULT_PLAN``       serialized :class:`FaultPlan` — round-0
                                workers only, so a seeded kill drill
                                does not re-fire after every resume
+``ZNICZ_TPU_METRICS_EXPORT``   rank-tagged registry snapshot file the
+                               worker atomically rewrites (``__main__``
+                               starts the exporter when set) — the
+                               supervisor's fleet aggregator ingests
+                               these beside the heartbeats (ISSUE 11)
 =============================  =========================================
+
+Fleet telemetry (ISSUE 11): the supervisor hosts an
+``observe/federation.py`` :class:`FleetAggregator` over the round's
+worker snapshot files — every flight artifact it dumps embeds each
+worker's last registry snapshot (the ``planes.fleet`` key), and
+``fleet_port=N`` / ``--fleet-port N`` serves the merged
+``/fleet/metrics[.prom]`` + ``/fleet/status.json`` view while the
+fleet runs.
 
 Determinism contract (pinned by tests/test_elastic.py): the workers'
 snapshot resume is the snapshotter's bit-exact resume, so a fleet killed
@@ -67,6 +80,7 @@ import time
 from typing import Mapping, Optional, Sequence
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.observe import federation as _federation
 from znicz_tpu.observe import flight as _flight
 from znicz_tpu.observe import probe as _probe
 from znicz_tpu.resilience import faults
@@ -235,7 +249,9 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
                 heartbeat_timeout: Optional[float] = None,
                 progress_timeout: Optional[float] = None,
                 boot_timeout: Optional[float] = None,
-                round_timeout: Optional[float] = None) -> ElasticReport:
+                round_timeout: Optional[float] = None,
+                fleet_port: Optional[int] = None,
+                metrics_interval: float = 1.0) -> ElasticReport:
     """Supervise an elastic worker fleet to completion.
 
     ``worker_argv`` is the CLI tail after ``python -m znicz_tpu`` (the
@@ -255,6 +271,11 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
     within this long of launch = hung boot; size it above worst
     jax-import + compile time), ``round_timeout`` (whole-round
     backstop).  ``policy`` supplies the restart budget + backoff.
+    ``fleet_port`` serves the fleet aggregator's merged telemetry
+    (``/fleet/metrics[.prom]``, ``/fleet/status.json``) while the fleet
+    runs (None = the aggregator still ingests worker snapshots so
+    flight artifacts embed them, just no listener);
+    ``metrics_interval`` is the workers' snapshot-export cadence.
 
     Returns an :class:`ElasticReport`; raises :class:`ElasticExhausted`
     when the budget is spent.
@@ -275,14 +296,25 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
     # fleet could never complete — plans reach workers only through
     # ``fault_plans`` (round 0, per rank)
     base_env.pop(faults.PLAN_ENV_VAR, None)
+    # the fleet telemetry master view (ISSUE 11): sources re-registered
+    # per round, embedded into every flight dump via the "fleet" plane;
+    # staleness bound sized to the export cadence so a SIGKILL'd
+    # worker's series drop out instead of reading live forever
+    aggregator = _federation.FleetAggregator(
+        stale_s=max(10.0 * metrics_interval, 5.0))
     current: list = []       # the in-flight round's workers, shared with
     try:                     # the round loop so cleanup sees them all
+        if fleet_port is not None:
+            # inside the try: a bind failure must still run close(),
+            # which unregisters the "fleet" flight plane this
+            # aggregator registered at construction
+            aggregator.serve(port=fleet_port)
         return _supervise_rounds(
             worker_argv, snap_dir, schedule, policy, prefix, run_dir,
             spmd, coordinator_host, base_env, fault_plans, poll_s,
             term_grace, heartbeat_interval, heartbeat_timeout,
             progress_timeout, boot_timeout, round_timeout, report, log,
-            current)
+            current, aggregator, metrics_interval)
     finally:
         # ANY exit — completion, ElasticExhausted, KeyboardInterrupt,
         # a spawn OSError halfway through a round — must not orphan
@@ -293,6 +325,7 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
             log.warning(f"elastic: reaping {len(leaked)} live worker(s) "
                         f"on supervisor exit")
             _teardown(leaked, term_grace, log)
+        aggregator.close()
         _probe.elastic_world_size(0)
 
 
@@ -301,7 +334,8 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                       fault_plans, poll_s, term_grace,
                       heartbeat_interval, heartbeat_timeout,
                       progress_timeout, boot_timeout, round_timeout,
-                      report, log, current) -> ElasticReport:
+                      report, log, current, aggregator,
+                      metrics_interval) -> ElasticReport:
     """:func:`run_elastic`'s round loop, split out so the caller's
     try/finally can guarantee teardown of ``current`` on ANY exit."""
     round_no = 0
@@ -319,6 +353,7 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                            f"{_free_port(coordinator_host)}")
         current.clear()
         fleet: list = current          # shared with the caller's finally
+        aggregator.clear_sources()     # this round's files replace last
         for rank in range(world):
             argv = [sys.executable, "-m", "znicz_tpu", *worker_argv]
             if spmd:
@@ -328,12 +363,18 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
             if resume is not None:
                 argv += ["-w", resume]
             hb_path = os.path.join(run_dir, f"hb_r{round_no}_w{rank}")
+            mx_path = os.path.join(run_dir,
+                                   f"metrics_r{round_no}_w{rank}.json")
             worker_env = dict(base_env)
             worker_env[RANK_ENV] = str(rank)
             worker_env[WORLD_ENV] = str(world)
             worker_env[SNAP_DIR_ENV] = str(snap_dir)
             worker_env[HEARTBEAT_ENV] = hb_path
             worker_env[HEARTBEAT_INTERVAL_ENV] = repr(heartbeat_interval)
+            worker_env[_federation.METRICS_EXPORT_ENV] = mx_path
+            worker_env[_federation.METRICS_EXPORT_INTERVAL_ENV] = \
+                repr(metrics_interval)
+            aggregator.add_file_source(rank, mx_path)
             if round_no == 0 and fault_plans and rank in fault_plans:
                 plan = fault_plans[rank]
                 worker_env[faults.PLAN_ENV_VAR] = (
@@ -458,7 +499,15 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
         if policy.flight_recorder:
             # the fleet-side post-mortem: which workers died, with what
             # codes, their last output lines, plus this process's whole
-            # telemetry state — dumped BEFORE the relaunch overwrites it
+            # telemetry state — dumped BEFORE the relaunch overwrites
+            # it.  One forced scrape first: the artifact's "fleet"
+            # plane then embeds each worker's LAST exported registry
+            # snapshot (the dead rank's included), ledger-checkable
+            # without any live worker
+            try:
+                aggregator.refresh(force=True)
+            except Exception:  # noqa: BLE001 — telemetry must not
+                pass           # block the post-mortem
             try:
                 report.flights.append(_flight.dump(
                     dir=run_dir,
@@ -551,6 +600,12 @@ def elastic_main(argv) -> int:
                         "size it above worst jax-import + compile time)")
     p.add_argument("--round-timeout", type=float, default=None)
     p.add_argument("--term-grace", type=float, default=5.0)
+    p.add_argument("--fleet-port", type=int, default=None,
+                   help="serve the fleet aggregator's merged telemetry "
+                        "(/fleet/metrics[.prom], /fleet/status.json) on "
+                        "this port while the fleet runs (0 picks a free "
+                        "one; default: no listener — worker snapshots "
+                        "still feed flight artifacts)")
     p.add_argument("--fault-plan", action="append", default=[],
                    metavar="RANK=JSON",
                    help="arm a serialized FaultPlan (FaultPlan.to_env "
@@ -586,7 +641,8 @@ def elastic_main(argv) -> int:
             heartbeat_timeout=args.heartbeat_timeout,
             progress_timeout=args.progress_timeout,
             boot_timeout=args.boot_timeout,
-            round_timeout=args.round_timeout)
+            round_timeout=args.round_timeout,
+            fleet_port=args.fleet_port)
     except ElasticExhausted as exc:
         print(f"elastic: {exc}", file=sys.stderr)
         return 1

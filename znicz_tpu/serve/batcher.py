@@ -33,6 +33,9 @@ from concurrent.futures import Future
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.observe import flight as _flight
+from znicz_tpu.observe import trace as _trace
+from znicz_tpu.observe.federation import next_request_id, request_track
 from znicz_tpu.serve.metrics import ServingMetrics
 
 
@@ -48,15 +51,18 @@ class _Request:
     """One client request; ``parts`` collects per-chunk outputs."""
 
     __slots__ = ("future", "deadline", "t_submit", "parts", "remaining",
-                 "failed")
+                 "failed", "rid", "t0_perf")
 
-    def __init__(self, n_chunks: int, deadline, t_submit: float) -> None:
+    def __init__(self, n_chunks: int, deadline, t_submit: float,
+                 rid: str) -> None:
         self.future: Future = Future()
         self.deadline = deadline            # monotonic stamp or None
         self.t_submit = t_submit
         self.parts: list = [None] * n_chunks
         self.remaining = n_chunks
         self.failed = False
+        self.rid = rid                      # trace correlation key
+        self.t0_perf = time.perf_counter()  # admission span anchor
 
 
 class _Chunk:
@@ -91,6 +97,10 @@ class MicroBatcher(Logger):
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._closing = False
+        # flight artifacts embed the predict plane's admission ledger
+        # too (ISSUE 11 satellite; see ContinuousBatcher)
+        self._flight_plane = self.metrics.snapshot
+        _flight.register_plane("serve_ledger", self._flight_plane)
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="micro-batcher")
         self._worker.start()
@@ -102,10 +112,12 @@ class MicroBatcher(Logger):
         return self._closing
 
     # -- client side ---------------------------------------------------------
-    def submit(self, x, timeout_s: float | None = None) -> Future:
+    def submit(self, x, timeout_s: float | None = None,
+               request_id: str | None = None) -> Future:
         """Admit one request; returns a Future resolving to the output
         rows in submission order.  Raises :class:`QueueFull` immediately
-        under backpressure or during drain."""
+        under backpressure or during drain.  ``request_id`` threads an
+        HTTP-admission trace id through (one minted otherwise)."""
         x = np.ascontiguousarray(x, np.float32)
         if x.ndim == 1:
             x = x[None]
@@ -130,7 +142,8 @@ class MicroBatcher(Logger):
                 f"request of {x.shape[0]} rows needs {n_chunks} chunks, "
                 f"more than the whole queue ({self.max_queue}); raise "
                 "max_queue/max_batch or split the request")
-        req = _Request(n_chunks=n_chunks, deadline=deadline, t_submit=now)
+        req = _Request(n_chunks=n_chunks, deadline=deadline, t_submit=now,
+                       rid=request_id or next_request_id())
         chunks = [_Chunk(req, i, x[o:o + step])
                   for i, o in enumerate(range(0, x.shape[0], step))]
         with self._cond:
@@ -222,6 +235,7 @@ class MicroBatcher(Logger):
 
     def _service(self, batch: list, rows: int) -> None:
         self.metrics.on_batch(rows)
+        t_infer = time.perf_counter()
         try:
             # concatenate inside the guard: with no engine input_shape
             # declared, mismatched per-request widths surface here and
@@ -236,6 +250,12 @@ class MicroBatcher(Logger):
                 self._fail(chunk.req, exc)
             return
         now = time.monotonic()
+        now_perf = time.perf_counter()
+        # one engine-dispatch span per coalesced batch (worker thread —
+        # strictly sequential, so batch spans nest cleanly)
+        _trace.TRACER.complete("serve.infer", t_infer,
+                               now_perf - t_infer, rows=rows,
+                               chunks=len(batch))
         offset = 0
         for chunk in batch:
             n = len(chunk.x)
@@ -258,6 +278,13 @@ class MicroBatcher(Logger):
                     self.metrics.on_request_failed()
                     continue
                 self.metrics.on_complete(now - req.t_submit)
+                # whole-request span (admission -> response resolved)
+                # on the request's own trace track
+                _trace.TRACER.complete(
+                    "serve.request", req.t0_perf,
+                    time.perf_counter() - req.t0_perf,
+                    tid=request_track(req.rid), rid=req.rid,
+                    chunks=len(req.parts))
 
     def _loop(self) -> None:
         while True:
@@ -289,6 +316,7 @@ class MicroBatcher(Logger):
                     self._fail(chunk.req, QueueFull("batcher shut down"))
             self._cond.notify_all()
         self._worker.join(timeout=join_timeout_s)
+        _flight.unregister_plane("serve_ledger", self._flight_plane)
         return not self._worker.is_alive()
 
     def __enter__(self) -> "MicroBatcher":

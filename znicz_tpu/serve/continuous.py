@@ -38,6 +38,9 @@ import time
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.observe import flight as _flight
+from znicz_tpu.observe import trace as _trace
+from znicz_tpu.observe.federation import next_request_id, request_track
 from znicz_tpu.resilience.faults import fault_hook
 from znicz_tpu.serve.batcher import QueueFull
 from znicz_tpu.serve.kvcache import KVDecoder, TokenSampler
@@ -56,9 +59,14 @@ class TokenStream:
     True}`` — the same shapes ``POST /generate`` streams as ndjson.
     """
 
-    def __init__(self, prompt_len: int, max_new_tokens: int) -> None:
+    def __init__(self, prompt_len: int, max_new_tokens: int,
+                 request_id: str | None = None) -> None:
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        #: distributed-tracing correlation key (ISSUE 11): minted at
+        #: HTTP admission (or here for direct submits) and carried by
+        #: every phase span this request emits
+        self.request_id = request_id or next_request_id()
         self.tokens: list = []
         self.t_submit = time.monotonic()
         self.ttft_s: float | None = None
@@ -130,7 +138,8 @@ class TokenStream:
 
 class _GenRequest:
     __slots__ = ("stream", "prompt", "max_new", "sampler", "deadline",
-                 "pos", "next_token", "emitted", "finished")
+                 "pos", "next_token", "emitted", "finished", "track",
+                 "t0_perf", "first_perf")
 
     def __init__(self, stream: TokenStream, prompt: np.ndarray,
                  max_new: int, sampler: TokenSampler,
@@ -144,6 +153,11 @@ class _GenRequest:
         self.next_token = 0                 # token to feed next step
         self.emitted = 0
         self.finished = False
+        #: trace anchors (ISSUE 11): every phase span of this request
+        #: lands on one synthetic per-request track
+        self.track = request_track(stream.request_id)
+        self.t0_perf = time.perf_counter()      # admission (queue start)
+        self.first_perf: float | None = None    # first token sampled
 
     @property
     def total_budget(self) -> int:
@@ -182,6 +196,13 @@ class ContinuousBatcher(Logger):
         self._cond = threading.Condition()
         self._closing = False
         self._drain = True
+        # ISSUE 11 satellite: flight artifacts dumped in this process
+        # embed the live admission ledger (admitted/completed/failed/
+        # abandoned), so a post-mortem checks ledger equality without a
+        # live scrape.  One provider object so stop() can unregister
+        # exactly what it registered (newest batcher wins the name).
+        self._flight_plane = self.metrics.snapshot
+        _flight.register_plane("generate_ledger", self._flight_plane)
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
         self._worker.start()
@@ -193,11 +214,14 @@ class ContinuousBatcher(Logger):
     # -- client side ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-               timeout_s: float | None = None) -> TokenStream:
+               timeout_s: float | None = None,
+               request_id: str | None = None) -> TokenStream:
         """Admit one generation; returns its :class:`TokenStream`.
         Raises :class:`QueueFull` under backpressure or during drain,
         ``ValueError`` on never-servable input (bad ids, budget beyond
-        the decoder's ``max_len``)."""
+        the decoder's ``max_len``).  ``request_id`` threads an
+        HTTP-admission trace id through; direct callers get one
+        minted."""
         ids = np.asarray(prompt, np.int32).ravel()
         if ids.size < 1:
             raise ValueError("empty prompt")
@@ -218,7 +242,8 @@ class ContinuousBatcher(Logger):
                              f"{timeout_s}")
         sampler = TokenSampler(seed=seed, temperature=temperature,
                                top_k=top_k)
-        stream = TokenStream(ids.size, max_new_tokens)
+        stream = TokenStream(ids.size, max_new_tokens,
+                             request_id=request_id)
         deadline = None if timeout_s is None else \
             time.monotonic() + timeout_s
         req = _GenRequest(stream, ids, max_new_tokens, sampler, deadline)
@@ -243,6 +268,16 @@ class ContinuousBatcher(Logger):
             return
         req.finished = True
         req.stream.finish_step = self.step_count
+        if req.first_perf is not None:
+            # the decode phase span: first sampled token -> terminal
+            # event, on the request's own trace track (per-step timing
+            # lives in the batched generate.decode_step spans; this one
+            # makes a single request's tail attributable end to end)
+            t1 = time.perf_counter()
+            _trace.TRACER.complete(
+                "generate.decode", req.first_perf, t1 - req.first_perf,
+                tid=req.track, rid=req.stream.request_id,
+                n_tokens=req.emitted)
         req.stream._push_terminal(event)
         if "error" in event:
             self.metrics.on_failed()
@@ -255,6 +290,7 @@ class ContinuousBatcher(Logger):
         if req.emitted == 0:
             req.stream.ttft_s = time.monotonic() - req.stream.t_submit
             req.stream.first_token_step = self.step_count
+            req.first_perf = time.perf_counter()
             self.metrics.on_first_token(req.stream.ttft_s)
         req.stream._push_token(token)
         req.emitted += 1
@@ -291,6 +327,13 @@ class ContinuousBatcher(Logger):
                     return
                 req = self._pending.pop(0)
             now = time.monotonic()
+            # queue-wait phase span: admission -> leaving the wait queue
+            # (expired/cancelled requests keep theirs — the span IS the
+            # evidence the queue killed them)
+            t_dequeue = time.perf_counter()
+            _trace.TRACER.complete(
+                "generate.queue", req.t0_perf, t_dequeue - req.t0_perf,
+                tid=req.track, rid=req.stream.request_id)
             if req.stream.cancelled:
                 self._finish(req, {"done": True, "reason": "aborted",
                                    "n_tokens": 0})
@@ -302,6 +345,7 @@ class ContinuousBatcher(Logger):
                     "done": True})
                 continue
             slot = free[0]
+            t_prefill = time.perf_counter()
             try:
                 need = self.decoder.bucket_for(max(
                     [req.total_budget] +
@@ -325,6 +369,11 @@ class ContinuousBatcher(Logger):
                 self._finish(req, {"error": f"prefill failed: {exc!r}",
                                    "done": True})
                 continue
+            _trace.TRACER.complete(
+                "generate.prefill", t_prefill,
+                time.perf_counter() - t_prefill, tid=req.track,
+                rid=req.stream.request_id, prompt_len=len(req.prompt),
+                slot=slot)
             req.pos = len(req.prompt)
             self.slots[slot] = req
             token = req.sampler.sample(logits)
@@ -341,12 +390,21 @@ class ContinuousBatcher(Logger):
         fault_hook("generate.step", batcher=self)
         pos = np.zeros(len(self.slots), np.int32)
         tok = np.zeros(len(self.slots), np.int32)
+        active = 0
         for i, req in enumerate(self.slots):
             if req is not None:
                 pos[i] = req.pos
                 tok[i] = req.next_token
+                active += 1
+        t_step = time.perf_counter()
         self._kv, logits = self.decoder.decode(self._kv, pos, tok)
         self.step_count += 1
+        # one batched decode-step span per step (worker thread): a
+        # request's share of it is bracketed by its first_token_step /
+        # finish_step counters
+        _trace.TRACER.complete("generate.decode_step", t_step,
+                               time.perf_counter() - t_step,
+                               step=self.step_count, active=active)
         now = time.monotonic()
         for i, req in enumerate(self.slots):
             if req is None:
@@ -420,6 +478,7 @@ class ContinuousBatcher(Logger):
             self._drain = drain
             self._cond.notify_all()
         self._worker.join(timeout=join_timeout_s)
+        _flight.unregister_plane("generate_ledger", self._flight_plane)
         return not self._worker.is_alive()
 
     def __enter__(self) -> "ContinuousBatcher":

@@ -81,6 +81,13 @@ _M_GEN_TPS = _metrics.gauge(
 _M_GEN_ABANDONED = _metrics.counter(
     "znicz_generate_abandoned_total",
     "requests abandoned by the client (cancel / disconnect)")
+# ISSUE 11: the generative wait queue was only in the instance
+# snapshot; the fleet aggregator's "total queue depth across N
+# workers" autoscaler rule needs it in the scrapeable registry like
+# znicz_serve_queue_depth
+_M_GEN_QUEUE = _metrics.gauge(
+    "znicz_generate_queue_depth",
+    "admitted generations waiting for a decode slot (newest batcher)")
 
 
 class LatencyHistogram:
@@ -309,8 +316,10 @@ class GenerateMetrics:
         with self._lock:
             self.admitted += 1
             self.queue_depth += 1
+            depth = self.queue_depth
         if _probe.enabled():
             _M_GEN_REQUESTS.labels(event="admitted").inc()
+            _M_GEN_QUEUE.set(depth)
 
     def on_reject(self) -> None:
         with self._lock:
@@ -324,6 +333,7 @@ class GenerateMetrics:
             self.queue_depth = queued
         if _probe.enabled():
             _M_GEN_SLOTS.set(active)
+            _M_GEN_QUEUE.set(queued)
 
     def on_first_token(self, ttft_s: float) -> None:
         with self._lock:

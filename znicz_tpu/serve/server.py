@@ -10,7 +10,11 @@ telemetry needed to operate the thing is one GET away.
                     -> 200 {"output": [...]}
                     |  400 bad request  | 503 queue full (backpressure)
                     |  504 deadline exceeded
-    GET  /metrics   -> serving + engine counters (metrics.py schema)
+    GET  /metrics       -> serving + engine counters (metrics.py schema)
+    GET  /metrics.prom  -> process registry, Prometheus text (the fleet
+                           aggregator's scrape target, ISSUE 11)
+    GET  /trace.json    -> this worker's span ring, rank-anchored for
+                           the fleet trace merge
     GET  /healthz   -> {"status": "ok"}  (200 while accepting traffic)
     GET  /          -> model metadata (PredictionServer-compatible)
 
@@ -30,11 +34,14 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.observe import trace as _trace
+from znicz_tpu.observe.federation import next_request_id, request_track
 from znicz_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from znicz_tpu.serve.engine import BatchEngine, load_backend
 
@@ -61,6 +68,29 @@ class _JsonHandler(BaseHTTPRequestHandler):
     def _reply_healthz(self, draining: bool) -> None:
         self._reply(503 if draining else 200,
                     {"status": "draining" if draining else "ok"})
+
+    def _reply_prom(self) -> None:
+        """``GET /metrics.prom``: the process-global registry in
+        Prometheus text — the fleet aggregator's scrape target on BOTH
+        serving planes (ISSUE 11)."""
+        from znicz_tpu.observe import REGISTRY
+
+        body = REGISTRY.render_prometheus().encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_trace(self) -> None:
+        """``GET /trace.json``: this worker's tracer ring (request
+        phase spans included), rank-anchored so
+        ``federation.merge_traces`` / ``/fleet/trace.json`` can align
+        it with its peers."""
+        from znicz_tpu.observe import TRACER
+
+        self._reply(200, TRACER.export_dict())
 
 
 class ServeServer(Logger):
@@ -109,8 +139,12 @@ class ServeServer(Logger):
 
         class Handler(_JsonHandler):
             def do_GET(self):
-                if self.path.startswith("/metrics"):
+                if self.path.startswith("/metrics.prom"):
+                    self._reply_prom()
+                elif self.path.startswith("/metrics"):
                     self._reply(200, plane.metrics_snapshot())
+                elif self.path.startswith("/trace.json"):
+                    self._reply_trace()
                 elif self.path.startswith("/healthz"):
                     self._reply_healthz(plane.batcher.draining)
                 else:
@@ -120,11 +154,13 @@ class ServeServer(Logger):
                 if not self.path.startswith("/predict"):
                     self._reply(404, {"error": "POST /predict"})
                     return
+                rid = next_request_id()      # minted at HTTP admission
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     doc = json.loads(self.rfile.read(n))
                     future = plane.batcher.submit(
-                        doc["input"], timeout_s=doc.get("timeout_s"))
+                        doc["input"], timeout_s=doc.get("timeout_s"),
+                        request_id=rid)
                 except QueueFull as exc:
                     self._reply(503, {"error": str(exc)},
                                 headers=(("Retry-After", "1"),))
@@ -145,7 +181,8 @@ class ServeServer(Logger):
                 except Exception as exc:  # noqa: BLE001 — engine failure
                     self._reply(500, {"error": str(exc)})
                     return
-                self._reply(200, {"output": np.asarray(out).tolist()})
+                self._reply(200, {"output": np.asarray(out).tolist()},
+                            headers=(("X-Request-Id", rid),))
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -209,6 +246,9 @@ class GenerateServer(Logger):
                stream mode; streamed deadlines arrive as the sentinel)
         GET  /metrics       -> {"generate": ..., "decoder": ...}
         GET  /metrics.prom  -> process registry, Prometheus text
+        GET  /trace.json    -> span ring incl. per-request phase spans
+                               (queue/prefill/decode/stream, linked by
+                               request id on one synthetic track)
         GET  /healthz       -> 200 ok | 503 draining
         GET  /              -> model metadata
 
@@ -256,7 +296,7 @@ class GenerateServer(Logger):
                 "slots": self.decoder.batch,
                 "n_requests": self.metrics.snapshot()["admitted"]}
 
-    def _submit_doc(self, doc: dict):
+    def _submit_doc(self, doc: dict, request_id: str | None = None):
         """Parse one /generate body and admit it; returns the stream.
         Raises ValueError (400) / QueueFull (503)."""
         if "tokens" in doc:
@@ -271,7 +311,8 @@ class GenerateServer(Logger):
             temperature=float(doc.get("temperature", 0.0)),
             top_k=int(doc.get("top_k", 0)),
             seed=int(doc.get("seed", 0)),
-            timeout_s=doc.get("timeout_s"))
+            timeout_s=doc.get("timeout_s"),
+            request_id=request_id)
 
     # -- HTTP ----------------------------------------------------------------
     def start(self) -> int:
@@ -280,16 +321,11 @@ class GenerateServer(Logger):
         class Handler(_JsonHandler):
             def do_GET(self):
                 if self.path.startswith("/metrics.prom"):
-                    from znicz_tpu.observe import REGISTRY
-                    body = REGISTRY.render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply_prom()
                 elif self.path.startswith("/metrics"):
                     self._reply(200, plane.metrics_snapshot())
+                elif self.path.startswith("/trace.json"):
+                    self._reply_trace()
                 elif self.path.startswith("/healthz"):
                     self._reply_healthz(plane.batcher.draining)
                 else:
@@ -307,47 +343,63 @@ class GenerateServer(Logger):
             def _stream_events(self, stream, timeout_s) -> None:
                 """ndjson relay: every event the batcher emits becomes
                 one flushed line; a client that hangs up cancels the
-                generation (abandoned-request accounting)."""
+                generation (abandoned-request accounting).  The relay
+                itself is the request's ``generate.stream`` phase span
+                — queue/prefill/decode cover the worker side, this one
+                covers the wire."""
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Request-Id", stream.request_id)
                 self.end_headers()      # no Content-Length: close-delimited
                 # terminal events are guaranteed; the slack only guards
                 # a wedged worker from pinning this handler thread
                 slack = self._slack(timeout_s)
-                while True:
-                    try:
-                        event = stream.next_event(timeout=slack)
-                    except TimeoutError:
-                        # the client gets a terminal error NOW; cancel
-                        # so a later-recovering worker frees the slot
-                        # instead of decoding for a gone client
-                        stream.cancel()
-                        event = {"error": "stream stalled (worker "
-                                 "unresponsive)", "done": True}
-                    if "token" in event and plane.charmap is not None:
-                        event = {**event, "text":
-                                 plane.decode_text([event["token"]])}
-                    try:
-                        self.wfile.write(
-                            (json.dumps(event) + "\n").encode())
-                        self.wfile.flush()
-                    except (BrokenPipeError, ConnectionResetError,
-                            OSError):
-                        stream.cancel()     # client hung up: free the
-                        return              # slot, count it abandoned
-                    if event.get("done"):
-                        return
+                t_stream = time.perf_counter()
+                n_events = 0
+                try:
+                    while True:
+                        try:
+                            event = stream.next_event(timeout=slack)
+                        except TimeoutError:
+                            # the client gets a terminal error NOW;
+                            # cancel so a later-recovering worker frees
+                            # the slot instead of decoding for a gone
+                            # client
+                            stream.cancel()
+                            event = {"error": "stream stalled (worker "
+                                     "unresponsive)", "done": True}
+                        if "token" in event and plane.charmap is not None:
+                            event = {**event, "text":
+                                     plane.decode_text([event["token"]])}
+                        try:
+                            self.wfile.write(
+                                (json.dumps(event) + "\n").encode())
+                            self.wfile.flush()
+                            n_events += 1
+                        except (BrokenPipeError, ConnectionResetError,
+                                OSError):
+                            stream.cancel()  # client hung up: free the
+                            return           # slot, count it abandoned
+                        if event.get("done"):
+                            return
+                finally:
+                    _trace.TRACER.complete(
+                        "generate.stream", t_stream,
+                        time.perf_counter() - t_stream,
+                        tid=request_track(stream.request_id),
+                        rid=stream.request_id, events=n_events)
 
             def do_POST(self):
                 if not self.path.startswith("/generate"):
                     self._reply(404, {"error": "POST /generate"})
                     return
+                rid = next_request_id()      # minted at HTTP admission
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     doc = json.loads(self.rfile.read(n))
                     if not isinstance(doc, dict):
                         raise ValueError("body must be a JSON object")
-                    stream = plane._submit_doc(doc)
+                    stream = plane._submit_doc(doc, request_id=rid)
                 except QueueFull as exc:
                     self._reply(503, {"error": str(exc)},
                                 headers=(("Retry-After", "1"),))
@@ -375,7 +427,10 @@ class GenerateServer(Logger):
                 self._reply(200, {"tokens": ids,
                                   "text": plane.decode_text(ids),
                                   "reason": "length",
-                                  "n_tokens": len(ids)})
+                                  "n_tokens": len(ids),
+                                  "request_id": stream.request_id},
+                            headers=(("X-Request-Id",
+                                      stream.request_id),))
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                           Handler)

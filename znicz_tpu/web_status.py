@@ -10,7 +10,9 @@ registry in Prometheus text exposition format (scrapeable);
 ``/trace.json`` dumps the global tracer's ring buffer as Chrome-trace
 JSON (loads in Perfetto); ``/timeseries.json`` serves the watchtower's
 retained delta ring (observe/watchtower.py) so history is readable
-without an external scraper; ``/`` renders a plain HTML table.  Stdlib
+without an external scraper; ``register_fleet`` additionally mounts a
+fleet aggregator's merged cross-process view under ``/fleet/*``
+(observe/federation.py); ``/`` renders a plain HTML table.  Stdlib
 ``http.server`` on a daemon thread — zero dependencies, CLI ``-s``
 (stealth) simply never starts it.  Endpoint table:
 docs/OBSERVABILITY.md.
@@ -36,6 +38,7 @@ class WebStatus(Logger):
         self.serving: list = []
         self.health: list = []
         self.pipelines: list = []
+        self.fleet = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = port
@@ -86,6 +89,14 @@ class WebStatus(Logger):
         self.pipelines.append((str(name), fn))
         return self
 
+    def register_fleet(self, aggregator) -> "WebStatus":
+        """Mount a :class:`~znicz_tpu.observe.federation.
+        FleetAggregator`'s merged cross-process view under ``/fleet/*``
+        (``/fleet/metrics``, ``/fleet/metrics.prom``,
+        ``/fleet/status.json``, ``/fleet/trace.json``) — ISSUE 11."""
+        self.fleet = aggregator
+        return self
+
     # -- payload ------------------------------------------------------------
     def snapshot(self) -> dict:
         out = []
@@ -131,6 +142,18 @@ class WebStatus(Logger):
                 pass
 
             def do_GET(self):
+                if self.path.startswith("/fleet/") and \
+                        status.fleet is not None:
+                    payload = status.fleet.http_payload(self.path)
+                    if payload is not None:
+                        body, ctype = payload
+                        self.send_response(200)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                 if self.path.startswith("/status.json"):
                     body = json.dumps(status.snapshot()).encode()
                     ctype = "application/json"
