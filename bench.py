@@ -741,6 +741,121 @@ def bench_generate(slots=4, max_len=128, n_requests=16, max_new=24,
          f"{decoder.compile_count}")
 
 
+def bench_generate_longtail(slots=8, page=16, max_len=256, n_layers=2,
+                            d=48, heads=4, ff=96, vocab=64,
+                            arena_pages=73, spec_k=4):
+    """Long-tail mix arm (ISSUE 12): short+long greedy requests through
+    three decode planes over identical traffic — the PR 10 contiguous
+    shared-bucket baseline, the block-paged arena, and paged +
+    speculative (1-layer truncated draft).  The line reports tokens/sec
+    for all three, the slot ceiling and peak cache bytes at the paged
+    arena's resident-row budget, and the speculation acceptance rate.
+
+    Methodology: each arm runs the traffic once to PRIME its compiled
+    shapes (only the shapes this traffic actually dispatches — no full
+    warmup sweep), then once timed; the steady-state compile delta over
+    the timed pass is asserted 0 AFTER the line lands.  Exactness rides
+    along: the speculative stream must be token-identical to plain
+    paged decode (the ISSUE pin), and paged-vs-contiguous agreement is
+    reported."""
+    import numpy as np
+
+    from znicz_tpu.parallel.transformer import init_params
+    from znicz_tpu.serve import (ContinuousBatcher, KVDecoder,
+                                 PagedKVDecoder, truncate_draft)
+
+    params = init_params(np.random.default_rng(7), n_layers, d, heads,
+                         ff, vocab)
+    rng = np.random.default_rng(2)
+    reqs = []
+    for _ in range(16):                  # the short majority
+        plen = int(rng.integers(4, 12))
+        reqs.append((rng.integers(0, vocab, size=plen).tolist(), 16))
+    for _ in range(4):                   # the long tail
+        reqs.append((rng.integers(0, vocab, size=16).tolist(), 176))
+    reqs = [reqs[i] for i in rng.permutation(len(reqs))]
+
+    def run(decoder, draft=None):
+        batcher = ContinuousBatcher(decoder, max_queue=len(reqs),
+                                    default_timeout_s=600.0,
+                                    draft=draft, spec_k=spec_k)
+        t0 = time.perf_counter()
+        streams = [batcher.submit(p, max_new_tokens=m)
+                   for p, m in reqs]
+        outs = [s.result(timeout_s=600) for s in streams]
+        elapsed = time.perf_counter() - t0
+        snap = batcher.metrics.snapshot()
+        bucket = batcher._bucket        # contiguous shared-cache rows
+        batcher.stop()
+        assert snap["completed"] == len(reqs), \
+            (f"long-tail ledger broke: {snap['completed']} of "
+             f"{len(reqs)} completed ({snap})")
+        # peak concurrently-live slots off the step-counter intervals
+        # (deterministic — no wall-clock sampling)
+        events = sorted([(s.first_token_step, 1) for s in streams] +
+                        [(s.finish_step, -1) for s in streams])
+        peak = cur = 0
+        for _, delta in events:
+            cur += delta
+            peak = max(peak, cur)
+        tokens = sum(len(o) for o in outs)
+        return outs, tokens / elapsed, snap, peak, bucket
+
+    row_bytes = n_layers * heads * (d // heads) * 2 * 4  # K+V, f32
+
+    contig = KVDecoder(params, heads=heads, max_len=max_len,
+                       batch=slots)
+    run(contig)                                          # prime
+    c0 = contig.compile_count
+    outs_c, tps_c, snap_c, _, bucket_c = run(contig)
+    delta_c = contig.compile_count - c0
+
+    pdec = PagedKVDecoder(params, heads=heads, max_len=max_len,
+                          batch=slots, page=page,
+                          arena_pages=arena_pages)
+    run(pdec)                                            # prime
+    p0 = pdec.compile_count
+    outs_p, tps_p, snap_p, peak_slots, _ = run(pdec)
+    delta_p = pdec.compile_count - p0
+
+    draft = PagedKVDecoder(truncate_draft(params, 1), heads=heads,
+                           max_len=max_len, batch=slots, page=page)
+    run(pdec, draft=draft)                               # prime
+    s0 = pdec.compile_count + draft.compile_count
+    outs_s, tps_s, snap_s, _, _ = run(pdec, draft=draft)
+    delta_s = pdec.compile_count + draft.compile_count - s0
+
+    judged = snap_s["spec_accepted"] + snap_s["spec_rejected"]
+    arena_rows = (arena_pages - 1) * page
+    _emit("generate_longtail_tokens_per_sec", tps_p,
+          unit="tokens/sec",
+          contiguous_tokens_per_sec=round(tps_c, 1),
+          paged_speedup=round(tps_p / tps_c, 3),
+          spec_tokens_per_sec=round(tps_s, 1),
+          spec_speedup=round(tps_s / tps_c, 3),
+          spec_acceptance_rate=round(
+              snap_s["spec_accepted"] / judged, 3) if judged else 0.0,
+          ttft_p50_ms=snap_p["ttft"]["p50_ms"],
+          ttft_p95_ms=snap_p["ttft"]["p95_ms"],
+          slot_ceiling_paged=peak_slots,
+          slot_ceiling_contiguous=arena_rows // bucket_c,
+          peak_cache_bytes_paged=pdec.ledger.peak_used * page *
+          row_bytes,
+          peak_cache_bytes_contiguous=slots * bucket_c * row_bytes,
+          paged_matches_contiguous=outs_p == outs_c,
+          requests=len(reqs), slots=slots, page=page,
+          arena_pages=arena_pages,
+          steady_state_compile_delta=delta_c + delta_p + delta_s,
+          cpu=True)
+    # the speculation exactness pin and the zero-recompile contract
+    # fail the scenario loudly AFTER the line lands
+    assert outs_s == outs_p, \
+        "speculative greedy decode diverged from plain paged decode"
+    assert delta_c == delta_p == delta_s == 0, \
+        (f"steady-state recompiled: contiguous {delta_c}, paged "
+         f"{delta_p}, speculative {delta_s}")
+
+
 def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
                          n_valid=2560, hidden=512, reps=2):
     """Input-pipeline scenario (ISSUE 4): sync vs prefetch=2 through the
@@ -1138,6 +1253,7 @@ def child_main(mode: str) -> None:
         jax.config.update("jax_platforms", "cpu")
         _enable_compile_cache()
         bench_generate()
+        bench_generate_longtail()
         return
     if mode == "metrics_overhead":
         # telemetry-plane scenario: CPU by design (measures the
@@ -1287,8 +1403,11 @@ def main():
         # compile_latency's own legs each budget up to CPU_TIMEOUT (two
         # fresh-process probes + the AOT export leg) — its OUTER timeout
         # must exceed their sum or a slow-but-in-budget cold probe gets
-        # the whole scenario killed mid-warm-probe
+        # the whole scenario killed mid-warm-probe.  generate runs the
+        # base scenario PLUS the three-arm long-tail comparison (each
+        # arm primes then times), so it gets a doubled budget too.
         budget = 4 * CPU_TIMEOUT if extra_mode == "compile_latency" \
+            else 2 * CPU_TIMEOUT if extra_mode == "generate" \
             else CPU_TIMEOUT
         extra_results, note = _run_child(extra_mode, budget,
                                          platform="cpu")

@@ -45,6 +45,16 @@ if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/generate_smoke.py; then
          "generate_smoke lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 12 smoke: speculative decoding exactness — two fresh-process
+# boots from one draft-carrying LM package must stream BYTE-IDENTICAL
+# greedy text with speculation on vs off, and the spec/pages metric
+# families must be live (docs/SERVING.md "Speculative decoding";
+# ZNICZ_TPU_COMPILE_CACHE=off per the box note)
+if ! timeout -k 5 300 env JAX_PLATFORMS=cpu python tools/generate_smoke.py --speculative; then
+    echo "tools/t1.sh: speculative decoding smoke FAILED (see" \
+         "generate_smoke lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 # ISSUE 11 smoke: fleet telemetry — boot 2 real generate workers with
 # rank env, aggregate their /metrics.prom into one rank-labeled fleet
 # view, assert a fleet rule evaluates over the merged series and the

@@ -20,13 +20,16 @@ from znicz_tpu.serve.engine import BatchEngine, bucket_sizes, load_backend
 from znicz_tpu.serve.kvcache import KVDecoder, TokenSampler
 from znicz_tpu.serve.metrics import (GenerateMetrics, LatencyHistogram,
                                      ServingMetrics)
+from znicz_tpu.serve.paged import (ArenaExhausted, PagedKVDecoder,
+                                   PageLedger, truncate_draft)
 from znicz_tpu.serve.server import (GenerateServer, ServeServer,
                                     generate_main, serve_main)
 
 __all__ = [
-    "BatchEngine", "ContinuousBatcher", "DeadlineExceeded",
-    "GenerateMetrics", "GenerateServer", "GenerationError", "KVDecoder",
-    "LatencyHistogram", "MicroBatcher", "QueueFull", "ServeServer",
+    "ArenaExhausted", "BatchEngine", "ContinuousBatcher",
+    "DeadlineExceeded", "GenerateMetrics", "GenerateServer",
+    "GenerationError", "KVDecoder", "LatencyHistogram", "MicroBatcher",
+    "PagedKVDecoder", "PageLedger", "QueueFull", "ServeServer",
     "ServingMetrics", "TokenSampler", "TokenStream", "bucket_sizes",
-    "generate_main", "load_backend", "serve_main",
+    "generate_main", "load_backend", "serve_main", "truncate_draft",
 ]

@@ -147,8 +147,13 @@ class KVDecoder(Logger):
         if total_len < 1:
             raise ValueError("empty sequence")
         if total_len > self.max_len:
-            raise ValueError(f"sequence of {total_len} tokens > max_len "
-                             f"{self.max_len}")
+            # admission-time rejection (400, never a burned slot): the
+            # message names the configured limit so a client knows what
+            # to shrink — prompt + max_tokens must fit --max-len
+            raise ValueError(
+                f"sequence of {total_len} tokens (prompt + max_tokens) "
+                f"exceeds this server's max_len {self.max_len} "
+                f"(--max-len)")
         for b in self.buckets:
             if total_len <= b:
                 return b

@@ -88,6 +88,20 @@ _M_GEN_ABANDONED = _metrics.counter(
 _M_GEN_QUEUE = _metrics.gauge(
     "znicz_generate_queue_depth",
     "admitted generations waiting for a decode slot (newest batcher)")
+# ISSUE 12: paged-arena occupancy + speculation acceptance — the
+# autoscaler/fleet-rule signals for the generative memory plane (the
+# queue-depth precedent: scrapeable, not snapshot-only)
+_M_GEN_PAGES_TOTAL = _metrics.gauge(
+    "znicz_generate_cache_pages_total",
+    "allocatable KV-arena pages (scratch page excluded; newest paged "
+    "batcher)")
+_M_GEN_PAGES_USED = _metrics.gauge(
+    "znicz_generate_cache_pages_used",
+    "KV-arena pages held by live generations (newest paged batcher)")
+_M_GEN_SPEC = _metrics.counter(
+    "znicz_generate_spec_tokens_total",
+    "speculative draft tokens judged by the target verify pass",
+    labelnames=("event",))
 
 
 class LatencyHistogram:
@@ -307,6 +321,10 @@ class GenerateMetrics:
         self.tokens = 0
         self.active_slots = 0
         self.queue_depth = 0       # admitted, waiting for a slot
+        self.pages_used = 0        # paged arena only; 0 on contiguous
+        self.pages_total = 0
+        self.spec_accepted = 0     # draft tokens the target confirmed
+        self.spec_rejected = 0     # draft tokens the target overrode
         self.ttft = LatencyHistogram(TTFT_BUCKETS_MS)
         self._recent: deque = deque()       # (stamp, n_tokens)
         _M_GEN_TPS.set_function(self.tokens_per_sec)  # newest wins
@@ -371,6 +389,31 @@ class GenerateMetrics:
             _M_GEN_ABANDONED.inc()
             _M_GEN_REQUESTS.labels(event="abandoned").inc()
 
+    def on_pages(self, used: int, total: int) -> None:
+        """Paged-arena occupancy (ISSUE 12): called by the continuous
+        batcher whenever a page is allocated, appended or released."""
+        with self._lock:
+            self.pages_used = int(used)
+            self.pages_total = int(total)
+        if _probe.enabled():
+            _M_GEN_PAGES_USED.set(used)
+            _M_GEN_PAGES_TOTAL.set(total)
+
+    def on_spec(self, accepted: int, rejected: int) -> None:
+        """One slot's speculative round outcome: of the k draft
+        proposals the target verified, ``accepted`` matched its greedy
+        choice and ``rejected`` were overridden."""
+        with self._lock:
+            self.spec_accepted += int(accepted)
+            self.spec_rejected += int(rejected)
+        if _probe.enabled():
+            # inc(0) still CREATES the labeled child: the batcher's
+            # init-time on_spec(0, 0) pre-touch must materialize both
+            # series so fleet delta rules see a 0 baseline (the PR 11
+            # lesson), not a missing key
+            _M_GEN_SPEC.labels(event="accepted").inc(accepted)
+            _M_GEN_SPEC.labels(event="rejected").inc(rejected)
+
     # -- export -------------------------------------------------------------
     def tokens_per_sec(self) -> float:
         """Streamed tokens/sec over the sliding window (since-start
@@ -403,5 +446,9 @@ class GenerateMetrics:
                 "tokens": self.tokens,
                 "active_slots": self.active_slots,
                 "queue_depth": self.queue_depth,
+                "pages_used": self.pages_used,
+                "pages_total": self.pages_total,
+                "spec_accepted": self.spec_accepted,
+                "spec_rejected": self.spec_rejected,
                 "ttft": self.ttft.snapshot(),
             }

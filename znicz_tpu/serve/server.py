@@ -294,6 +294,8 @@ class GenerateServer(Logger):
                           "charmap": self.charmap is not None},
                 "max_len": self.decoder.max_len,
                 "slots": self.decoder.batch,
+                "paged": bool(getattr(self.decoder, "paged", False)),
+                "speculative": self.batcher._draft is not None,
                 "n_requests": self.metrics.snapshot()["admitted"]}
 
     def _submit_doc(self, doc: dict, request_id: str | None = None):
@@ -489,6 +491,31 @@ def build_generate_parser() -> argparse.ArgumentParser:
                    help="requests waiting for a slot; beyond it -> 503")
     p.add_argument("--timeout-s", type=float, default=60.0,
                    help="default per-request deadline")
+    p.add_argument("--no-paged", action="store_true",
+                   help="serve from per-slot contiguous cache buckets "
+                        "instead of the block-paged KV arena")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="KV arena rows per page (paged serving)")
+    p.add_argument("--arena-pages", type=int, default=0,
+                   help="total KV arena pages shared by all slots "
+                        "(0 = worst case: slots x max_len rows); "
+                        "smaller values bank on the long tail and set "
+                        "the real slot ceiling")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative decoding: the package's draft "
+                        "model (or --draft-layers) proposes, the "
+                        "target verifies — greedy output is "
+                        "token-identical to plain decode")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens proposed per speculative round")
+    p.add_argument("--draft-layers", type=int, default=0,
+                   help="with --speculative and no draft in the "
+                        "package: truncate the target to its first N "
+                        "layers as the draft")
+    p.add_argument("--pallas-decode", action="store_true",
+                   help="route single-query decode attention through "
+                        "the Pallas flash-decode kernel (interpret "
+                        "mode off-TPU)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the cache buckets")
     p.add_argument("--smoke-test", action="store_true",
@@ -547,12 +574,54 @@ def generate_main(argv) -> int:
                           "decoder": decoder.stats()}),
               file=__import__("sys").stderr)
         return 0
-    decoder = KVDecoder(params, heads=meta["heads"],
-                        max_len=args.max_len, batch=args.slots)
-    if not args.no_warmup:
-        decoder.warmup()
+    draft = None
+    if args.no_paged:
+        if args.speculative:
+            print("generate: --speculative needs the paged arena "
+                  "(drop --no-paged)")
+            return 2
+        decoder = KVDecoder(params, heads=meta["heads"],
+                            max_len=args.max_len, batch=args.slots)
+        if not args.no_warmup:
+            decoder.warmup()
+    else:
+        from znicz_tpu.serve.paged import PagedKVDecoder, truncate_draft
+        from znicz_tpu.utils.export import load_lm_draft
+
+        decoder = PagedKVDecoder(
+            params, heads=meta["heads"], max_len=args.max_len,
+            batch=args.slots, page=args.page_size,
+            arena_pages=args.arena_pages or None,
+            use_pallas=args.pallas_decode)
+        if args.speculative:
+            if args.spec_k < 1:
+                print(f"generate: --spec-k must be >= 1, got "
+                      f"{args.spec_k}")
+                return 2
+            dparams, dmeta = load_lm_draft(args.package)
+            dheads = dmeta["heads"] if dmeta else meta["heads"]
+            if dparams is None and args.draft_layers:
+                dparams = truncate_draft(params, args.draft_layers)
+            if dparams is None:
+                print("generate: --speculative needs a draft model in "
+                      "the package (export_lm draft_params=...) or "
+                      "--draft-layers N")
+                return 2
+            # the draft's k+1 single-query steps per round ARE the
+            # flash-decode shape — the kernel flag covers both decoders
+            draft = PagedKVDecoder(
+                dparams, heads=dheads, max_len=args.max_len,
+                batch=args.slots, page=args.page_size,
+                arena_pages=args.arena_pages or None,
+                use_pallas=args.pallas_decode)
+        if not args.no_warmup:
+            decoder.warmup(spec_k=args.spec_k if args.speculative
+                           else None)
+            if draft is not None:
+                draft.warmup()
     batcher = ContinuousBatcher(decoder, max_queue=args.max_queue,
-                                default_timeout_s=args.timeout_s)
+                                default_timeout_s=args.timeout_s,
+                                draft=draft, spec_k=args.spec_k)
     server = GenerateServer(batcher, charmap=charmap, port=args.port,
                             name=meta.get("name", "lm"))
     port = server.start()

@@ -198,13 +198,19 @@ class TransformerLMStep(AcceleratedUnit):
         self.minibatch_size = count
 
     # -- serving handoff (ISSUE 10) -----------------------------------------
-    def export_lm(self, path: str) -> str:
+    def export_lm(self, path: str,
+                  draft_layers: int | None = None) -> str:
         """Package the trained params as a generative serving artifact
         (``utils/export.py::export_lm``): weights + architecture +
         the loader's charmap, bootable by ``python -m znicz_tpu
         generate`` into the KV-cache decode plane.  The SAME params
         that trained serve — the unified train/serve contract the serve
-        plane is built on."""
+        plane is built on.
+
+        ``draft_layers=k`` also ships a layer-truncated DRAFT model
+        (first k blocks + the shared embedding/head) for speculative
+        decoding (ISSUE 12) — the zero-extra-training proposer whose
+        logits track the target's."""
         import jax
 
         from znicz_tpu.utils.export import export_lm
@@ -217,10 +223,15 @@ class TransformerLMStep(AcceleratedUnit):
                              "(KV-cache decode serves dense FFN only)")
         params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
                               self._params)
+        draft = None
+        if draft_layers:
+            from znicz_tpu.serve.paged import truncate_draft
+            draft = truncate_draft(params, draft_layers)
         charmap = list(getattr(self.loader, "vocab", []) or []) or None
         wf = getattr(self, "workflow", None)
         return export_lm(params, path, heads=self.heads, charmap=charmap,
-                         name=getattr(wf, "name", None) or "char_lm")
+                         name=getattr(wf, "name", None) or "char_lm",
+                         draft_params=draft)
 
     # -- snapshot support ---------------------------------------------------
     def state_dict(self) -> dict:

@@ -94,15 +94,9 @@ def export_forward(workflow, path: str, use_ema: bool = False,
 LM_FORMAT = "znicz_tpu.lm/1"
 
 
-def export_lm(params, path: str, *, heads: int, charmap=None,
-              name: str = "lm") -> str:
-    """Package a ``parallel/transformer.py`` param pytree as a
-    generative serving artifact (.npz): flat weight arrays plus an
-    ``__lm__`` meta block carrying the architecture (layers/d/heads/ff/
-    vocab — everything :class:`~znicz_tpu.serve.kvcache.KVDecoder`
-    needs) and, for char LMs, the ``charmap`` (id -> character) so the
-    server can speak text on the wire.  ``heads`` is the one
-    architecture fact the shapes cannot reveal."""
+def _lm_arch(params, heads: int, prefix: str = ""):
+    """-> (arch meta dict, flat arrays dict) for one transformer param
+    pytree — shared by the target and draft halves of a package."""
     vocab, d = (int(s) for s in np.shape(params["emb"]))
     blocks = params["blocks"]
     if any("ew1" in blk for blk in blocks):
@@ -111,17 +105,51 @@ def export_lm(params, path: str, *, heads: int, charmap=None,
     ff = int(np.shape(blocks[0]["w1"])[1])
     if d % int(heads):
         raise ValueError(f"heads={heads} must divide d={d}")
+    arrays = {f"{prefix}emb": np.asarray(params["emb"], np.float32),
+              f"{prefix}head": np.asarray(params["head"], np.float32)}
+    for i, blk in enumerate(blocks):
+        for key, arr in blk.items():
+            arrays[f"{prefix}blocks.{i}.{key}"] = \
+                np.asarray(arr, np.float32)
+    meta = {"n_layers": len(blocks), "d": d, "heads": int(heads),
+            "ff": ff, "vocab": vocab}
+    return meta, arrays
+
+
+def export_lm(params, path: str, *, heads: int, charmap=None,
+              name: str = "lm", draft_params=None,
+              draft_heads: int | None = None) -> str:
+    """Package a ``parallel/transformer.py`` param pytree as a
+    generative serving artifact (.npz): flat weight arrays plus an
+    ``__lm__`` meta block carrying the architecture (layers/d/heads/ff/
+    vocab — everything :class:`~znicz_tpu.serve.kvcache.KVDecoder`
+    needs) and, for char LMs, the ``charmap`` (id -> character) so the
+    server can speak text on the wire.  ``heads`` is the one
+    architecture fact the shapes cannot reveal.
+
+    ``draft_params`` ships a smaller DRAFT transformer over the same
+    vocab alongside the target (ISSUE 12): its arrays ride under a
+    ``draft.`` prefix and its architecture under ``meta["draft"]``, so
+    ``--speculative`` serving boots both from one artifact
+    (:func:`load_lm_draft`).  ``draft_heads`` defaults to ``heads``."""
+    arch, arrays = _lm_arch(params, heads)
+    vocab = arch["vocab"]
     if charmap is not None and len(charmap) != vocab:
         raise ValueError(f"charmap has {len(charmap)} entries but the "
                          f"embedding carries vocab {vocab}")
-    arrays = {"emb": np.asarray(params["emb"], np.float32),
-              "head": np.asarray(params["head"], np.float32)}
-    for i, blk in enumerate(blocks):
-        for key, arr in blk.items():
-            arrays[f"blocks.{i}.{key}"] = np.asarray(arr, np.float32)
-    meta = {"format": LM_FORMAT, "name": name, "n_layers": len(blocks),
-            "d": d, "heads": int(heads), "ff": ff, "vocab": vocab,
-            "charmap": list(charmap) if charmap is not None else None}
+    meta = {"format": LM_FORMAT, "name": name, **arch,
+            "charmap": list(charmap) if charmap is not None else None,
+            "draft": None}
+    if draft_params is not None:
+        draft_arch, draft_arrays = _lm_arch(
+            draft_params, heads if draft_heads is None else draft_heads,
+            prefix="draft.")
+        if draft_arch["vocab"] != vocab:
+            raise ValueError(
+                f"draft vocab {draft_arch['vocab']} != target vocab "
+                f"{vocab} — the draft must share the charmap")
+        meta["draft"] = draft_arch
+        arrays.update(draft_arrays)
     # pid-unique temp (the PR 9 snapshot lesson): two processes
     # exporting to the same path must not tear a shared .tmp
     tmp = f"{path}.{os.getpid()}.tmp"
@@ -164,6 +192,35 @@ def load_lm(path: str):
                          f"{sum(not b for b in blocks)} of "
                          f"{len(blocks)} layers")
     return params, meta
+
+
+def load_lm_draft(path: str):
+    """-> ``(draft_params, draft_meta)`` from a package exported with
+    ``draft_params``, or ``(None, None)`` when the package carries no
+    draft.  The draft pytree has the same shape contract as the target
+    (``emb`` / ``head`` / ``blocks``) and boots a
+    :class:`~znicz_tpu.serve.paged.PagedKVDecoder` directly."""
+    with np.load(path, allow_pickle=False) as z:
+        if "__lm__" not in z:
+            raise ValueError(f"{path!r} is not an LM package")
+        meta = json.loads(str(z["__lm__"]))
+        draft_meta = meta.get("draft")
+        if not draft_meta:
+            return None, None
+        blocks: list = [{} for _ in range(int(draft_meta["n_layers"]))]
+        for key in z.files:
+            if key.startswith("draft.blocks."):
+                _, _, idx, leaf = key.split(".", 3)
+                if not 0 <= int(idx) < len(blocks):
+                    raise ValueError(
+                        f"{path!r} carries {key!r} but the draft meta "
+                        f"declares only {len(blocks)} layer(s)")
+                blocks[int(idx)][leaf] = z[key]
+        params = {"emb": z["draft.emb"], "head": z["draft.head"],
+                  "blocks": blocks}
+    if any(not blk for blk in blocks):
+        raise ValueError(f"{path!r} draft is missing block arrays")
+    return params, draft_meta
 
 
 # -- ahead-of-time serving artifacts (ISSUE 7) -------------------------------
