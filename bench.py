@@ -856,6 +856,164 @@ def bench_generate_longtail(slots=8, page=16, max_len=256, n_layers=2,
          f"{delta_p}, speculative {delta_s}")
 
 
+def bench_fleet(n_requests=24, max_new=8, flood_clients=8):
+    """Serving-fleet scenario (ISSUE 13), over REAL worker processes:
+
+    - **router overhead**: the same greedy generation is timed straight
+      against one worker and then through the fleet router — the line's
+      headline is the routed p95 (stable, trendable) and the
+      ``overhead_*`` fields carry the direct-vs-routed deltas the
+      ISSUE asks for;
+    - **autoscaler reaction**: a thread flood saturates the single
+      worker's admission queue until the fleet saturation rule
+      breaches, and the second line reports breach-to-new-worker-READY
+      wall time (boot + warmup + readiness gate — the real scale-up
+      latency an SLO burn-down sees).
+
+    The zero-lost ledger and the scale-up itself are asserted AFTER the
+    lines land."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from znicz_tpu.fleet import Autoscaler, FleetRouter, WorkerPool
+    from znicz_tpu.parallel.transformer import init_params
+    from znicz_tpu.utils.export import export_lm
+
+    tmp = tempfile.mkdtemp(prefix="znicz_bench_fleet_")
+    pool = router = None
+    try:
+        charmap = list("abcdefghijklmnopqrstuvwxyz .,!?")
+        params = init_params(np.random.default_rng(11), 2, 32, 4, 64,
+                             len(charmap))
+        pkg = os.path.join(tmp, "lm.npz")
+        export_lm(params, pkg, heads=4, charmap=charmap,
+                  name="bench_lm")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   ZNICZ_TPU_COMPILE_CACHE="off")
+        pool = WorkerPool(
+            pkg, plane="generate", env=env,
+            worker_args=("--slots", "2", "--max-len", "64"),
+            run_dir=os.path.join(tmp, "fleet"))
+        w0 = pool.spawn()
+        if not pool.wait_all_ready(timeout_s=240):
+            raise RuntimeError(f"fleet worker never ready: "
+                               f"{pool.snapshot()}")
+        pool.start_probes()
+
+        def timed(base: str, n: int) -> np.ndarray:
+            lats = []
+            for i in range(n + 3):
+                body = _json.dumps({"prompt": "ab",
+                                    "max_tokens": max_new,
+                                    "timeout_s": 60}).encode()
+                req = urllib.request.Request(
+                    base + "/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    lines = [_json.loads(raw) for raw in r]
+                dt = time.perf_counter() - t0
+                if not lines or not lines[-1].get("done") or \
+                        "error" in lines[-1]:
+                    raise RuntimeError(f"bench stream did not "
+                                       f"complete: {lines}")
+                if i >= 3:              # 3 primes per arm, same shape
+                    lats.append(dt)
+            return np.asarray(lats) * 1000.0
+
+        direct = timed(w0.base, n_requests)
+        router = FleetRouter(pool)
+        port = router.start()
+        base = f"http://127.0.0.1:{port}"
+        routed = timed(base, n_requests)
+        _emit("fleet_router_p95_ms", float(np.percentile(routed, 95)),
+              unit="ms", lower_is_better=True,
+              direct_p95_ms=round(float(np.percentile(direct, 95)), 2),
+              overhead_p95_ms=round(float(np.percentile(routed, 95) -
+                                          np.percentile(direct, 95)),
+                                    2),
+              overhead_p50_ms=round(float(np.percentile(routed, 50) -
+                                          np.percentile(direct, 50)),
+                                    2),
+              requests=n_requests, cpu=True)
+
+        # -- autoscaler reaction: flood one worker, time breach->ready
+        scaler = Autoscaler(pool, min_workers=1, max_workers=2,
+                            queue_high=3.0, breach_for_s=0.25,
+                            cooldown_s=5.0, idle_down_s=3600.0)
+        stop_flood = threading.Event()
+        flood_errors: list = []
+
+        def flood() -> None:
+            import urllib.error
+
+            body = _json.dumps({"prompt": "ab", "max_tokens": 48,
+                                "timeout_s": 120}).encode()
+            while not stop_flood.is_set():
+                req = urllib.request.Request(
+                    base + "/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=180) as r:
+                        for _ in r:
+                            pass
+                except urllib.error.HTTPError as exc:
+                    exc.read()
+                    if exc.code != 503:     # backpressure is the
+                        flood_errors.append(  # EXPECTED overload answer
+                            f"HTTP {exc.code}")
+                    time.sleep(0.1)
+                except Exception as exc:  # noqa: BLE001 — surfaced
+                    flood_errors.append(repr(exc))   # after the line
+                    time.sleep(0.1)
+
+        threads = [threading.Thread(target=flood, daemon=True)
+                   for _ in range(flood_clients)]
+        for t in threads:
+            t.start()
+        t0 = time.monotonic()
+        while scaler.last_reaction_s is None and \
+                time.monotonic() - t0 < 240:
+            scaler.tick()
+            time.sleep(0.25)
+        reaction = scaler.last_reaction_s
+        stop_flood.set()
+        for t in threads:
+            t.join(timeout=240)
+        scaler.stop()
+        snap = router.snapshot()
+        _emit("fleet_autoscale_reaction_sec",
+              float(reaction if reaction else 0.0), unit="seconds",
+              lower_is_better=True,
+              trend_valid=reaction is not None,
+              workers=pool.worker_count(), scale_ups=scaler.scale_ups,
+              router_ledger={k: snap[k] for k in
+                             ("admitted", "completed", "failed",
+                              "rejected", "client_gone")},
+              cpu=True)
+        # asserted AFTER the lines land (the scenario contract)
+        assert reaction is not None and reaction > 0.0, \
+            "autoscaler never reacted to the queue-saturation breach"
+        assert pool.worker_count() == 2 and pool.ready_count() == 2, \
+            f"scale-up did not land: {pool.snapshot()}"
+        assert snap["admitted"] == snap["completed"] + \
+            snap["failed"] + snap["client_gone"], \
+            f"router ledger does not close: {snap}"
+        assert not flood_errors, \
+            f"flood clients failed hard: {flood_errors[:3]}"
+    finally:
+        if router is not None:
+            router.stop()
+        if pool is not None:
+            pool.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
                          n_valid=2560, hidden=512, reps=2):
     """Input-pipeline scenario (ISSUE 4): sync vs prefetch=2 through the
@@ -1255,6 +1413,16 @@ def child_main(mode: str) -> None:
         bench_generate()
         bench_generate_longtail()
         return
+    if mode == "fleet":
+        # serving-fleet scenario (ISSUE 13): router overhead +
+        # autoscaler reaction over real worker subprocesses; the bench
+        # child itself only routes (CPU, no model math in-process)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_fleet()
+        return
     if mode == "metrics_overhead":
         # telemetry-plane scenario: CPU by design (measures the
         # observe instrumentation through the real run loop)
@@ -1398,7 +1566,7 @@ def main():
     # serving-plane / input-pipeline / metrics-overhead scenarios: their
     # own CPU children (independent of the chip pool), BEFORE the final
     # flagship re-emit so the driver's last-line contract is untouched
-    for extra_mode in ("serve", "generate", "pipeline",
+    for extra_mode in ("serve", "generate", "fleet", "pipeline",
                        "metrics_overhead", "compile_latency"):
         # compile_latency's own legs each budget up to CPU_TIMEOUT (two
         # fresh-process probes + the AOT export leg) — its OUTER timeout
@@ -1406,8 +1574,11 @@ def main():
         # the whole scenario killed mid-warm-probe.  generate runs the
         # base scenario PLUS the three-arm long-tail comparison (each
         # arm primes then times), so it gets a doubled budget too.
+        # fleet boots real worker subprocesses (one cold + one
+        # autoscaled) on top of its request sweeps — doubled budget
+        # like generate
         budget = 4 * CPU_TIMEOUT if extra_mode == "compile_latency" \
-            else 2 * CPU_TIMEOUT if extra_mode == "generate" \
+            else 2 * CPU_TIMEOUT if extra_mode in ("generate", "fleet") \
             else CPU_TIMEOUT
         extra_results, note = _run_child(extra_mode, budget,
                                          platform="cpu")

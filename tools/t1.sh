@@ -66,6 +66,18 @@ if ! timeout -k 5 300 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py; then
          "lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 13 smoke: serving fleet — the real `fleet` CLI boots a router
+# + 2 real generate workers from one LM package, streams through the
+# router under threaded traffic, performs one rolling weight update via
+# POST /rollout, and asserts zero lost requests + fleet convergence on
+# the new fingerprint + steady-state compile delta 0
+# (docs/SERVING.md "Fleet topology"; ZNICZ_TPU_COMPILE_CACHE=off per
+# the PR 9 box note)
+if ! timeout -k 5 400 env JAX_PLATFORMS=cpu python tools/fleet_router_smoke.py; then
+    echo "tools/t1.sh: serving-fleet router smoke FAILED (see" \
+         "fleet_router_smoke lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 # ISSUE 9 smoke: elastic kill-and-resume — 2 CPU worker processes, the
 # snapshot writer SIGKILL'd at a seeded step, fleet resumes at world
 # size 1; asserts completion + >= 1 flight artifact + resumes counter

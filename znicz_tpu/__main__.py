@@ -8,6 +8,8 @@ Usage:
     python -m znicz_tpu generate <lm_package.npz> [--prompt TEXT |
                                   --serve --port N --slots B] [options]
     python -m znicz_tpu aot <package.npz> [--max-batch N] [-o out.npz]
+    python -m znicz_tpu fleet <package.npz> [--workers N --port P
+                                  --autoscale] [-- worker flags ...]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
     python -m znicz_tpu trace --fleet -o <out.json> <src> [<src> ...]
     python -m znicz_tpu flight <flight_artifact.json> [--json]
@@ -230,6 +232,13 @@ def main(argv=None) -> int:
         from znicz_tpu.serve.server import generate_main
 
         return generate_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # the serving fleet (ISSUE 13): front-end router + worker pool
+        # + SLO autoscaler + rolling weight updates over ordinary
+        # serve/generate worker processes
+        from znicz_tpu.fleet.cli import fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "aot":
         # compile-latency plane (ISSUE 7): embed ahead-of-time serving
         # executables into a forward package so `serve` boots with zero

@@ -1,0 +1,19 @@
+"""Serving fleet (ISSUE 13): front-end router, SLO-driven autoscaler,
+zero-downtime rolling weight updates — the composition of three planes
+that already existed separately (PR 9 elastic process supervision,
+PR 10/12 drainable serving workers, PR 11 fleet telemetry) into one
+production topology: VELES's master–slave serving heritage (PAPER.md
+§1) in the master/worker shape TensorFlow's runtime standardized
+(Abadi et al. 2016, PAPERS.md).
+
+``python -m znicz_tpu fleet <package.npz> --workers N`` boots the whole
+thing; docs/SERVING.md "Fleet topology" is the operator's guide.
+"""
+
+from znicz_tpu.fleet.autoscale import Autoscaler
+from znicz_tpu.fleet.rollout import RollingUpdate, RolloutError
+from znicz_tpu.fleet.router import ROUTER_RANK, FleetRouter, NoReadyWorker
+from znicz_tpu.fleet.workers import FleetWorker, WorkerPool
+
+__all__ = ["Autoscaler", "FleetRouter", "FleetWorker", "NoReadyWorker",
+           "ROUTER_RANK", "RollingUpdate", "RolloutError", "WorkerPool"]

@@ -264,10 +264,10 @@ def merge_traces(docs: Sequence[dict]) -> dict:
         shift = 0.0 if base is None or origin is None \
             else (origin - base) * 1e6
         origins[str(pid)] = origin
+        name = doc.get("label") or (f"rank {rank}" if rank is not None
+                                    else f"source {i}")
         events.append({"ph": "M", "pid": pid, "tid": 0,
-                       "name": "process_name",
-                       "args": {"name": f"rank {rank}" if rank is not None
-                                else f"source {i}"}})
+                       "name": "process_name", "args": {"name": name}})
         for ev in doc.get("traceEvents", ()):
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
                 continue               # replaced by the rank row above
@@ -348,12 +348,17 @@ class _Source:
     """One worker's scrape target + its last successful ingest."""
 
     __slots__ = ("rank", "kind", "target", "ts", "families", "samples",
-                 "ok", "error", "scrapes")
+                 "ok", "error", "scrapes", "label")
 
-    def __init__(self, rank, kind: str, target) -> None:
+    def __init__(self, rank, kind: str, target,
+                 label: Optional[str] = None) -> None:
         self.rank = rank
         self.kind = kind                  # "http" | "file" | "callable"
         self.target = target
+        #: display name for non-worker sources (ISSUE 13: the serving
+        #: fleet's ROUTER scrapes into the same merged view — its trace
+        #: track reads "router", not "rank 9000")
+        self.label = label
         self.ts: Optional[float] = None   # wall stamp of the last ingest
         self.families: dict = {}
         self.samples: list = []
@@ -410,12 +415,16 @@ class FleetAggregator(Logger):
         _flight.register_plane("fleet", self._flight_plane)
 
     # -- sources -------------------------------------------------------------
-    def add_http_source(self, rank, base_url: str) -> "FleetAggregator":
+    def add_http_source(self, rank, base_url: str,
+                        label: Optional[str] = None) -> "FleetAggregator":
         """A serve/generate worker: ``<base_url>/metrics.prom`` is
-        scraped; its ``/trace.json`` feeds the merged fleet trace."""
+        scraped; its ``/trace.json`` feeds the merged fleet trace.
+        ``label`` names a non-worker source (the fleet ROUTER, ISSUE
+        13) on the merged trace's process row and in
+        ``/fleet/status.json``."""
         with self._lock:
             self._sources[int(rank)] = _Source(
-                int(rank), "http", base_url.rstrip("/"))
+                int(rank), "http", base_url.rstrip("/"), label=label)
         return self
 
     def add_file_source(self, rank, path: str) -> "FleetAggregator":
@@ -599,6 +608,7 @@ class FleetAggregator(Logger):
                     if not name.endswith("_bucket")}
             out[str(src.rank)] = {
                 "kind": src.kind,
+                "label": src.label,
                 "target": src.target if src.kind != "callable"
                 else repr(src.target),
                 "ok": src.ok, "error": src.error,
@@ -648,6 +658,8 @@ class FleetAggregator(Logger):
                     # here (setdefault would never fire on the
                     # explicit None export_dict always writes)
                     doc["rank"] = src.rank
+                if src.label:
+                    doc["label"] = src.label
                 docs.append(doc)
             except Exception as exc:  # noqa: BLE001 — merge what lives
                 missing.append(src.rank)

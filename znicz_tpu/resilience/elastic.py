@@ -151,31 +151,39 @@ def _read_heartbeat(path: str):
 
 # -- supervisor side ---------------------------------------------------------
 
-class _Worker:
-    """One spawned worker process + its log pump."""
+class WorkerProcess:
+    """One spawned worker process + its log pump — the fleet's unit of
+    supervision.  ISSUE 13 makes it the SHARED spawn/retire primitive:
+    the elastic training supervisor and the serving fleet's worker pool
+    (``znicz_tpu/fleet/workers.py``) both manage these, through
+    :func:`spawn_worker` / :func:`teardown_workers`, so process
+    lifecycle (log pumping, SIGTERM-grace-SIGKILL reaping, tail capture
+    for post-mortems) lives once."""
 
     def __init__(self, rank: int, proc: subprocess.Popen,
-                 heartbeat_path: str, log_path: str) -> None:
+                 heartbeat_path: str, log_path: str,
+                 log_tree: str = "elastic") -> None:
         self.rank = rank
         self.proc = proc
         self.heartbeat_path = heartbeat_path
         self.log_path = log_path
+        self.log_tree = log_tree
         self.tail: collections.deque = collections.deque(maxlen=40)
         self.started = time.monotonic()
         self.last_progress = -1
         self.last_progress_change = self.started
         self.killed = False          # teardown-initiated, not a death
         self._pump = threading.Thread(target=self._pump_output,
-                                      name=f"znicz-elastic-w{rank}-log",
+                                      name=f"znicz-{log_tree}-w{rank}-log",
                                       daemon=True)
         self._pump.start()
 
     def _pump_output(self) -> None:
         """Worker stdout/stderr -> per-worker log file + the supervisor's
-        logging tree under ``znicz_tpu.elastic.w<rank>`` (a configured
+        logging tree under ``znicz_tpu.<tree>.w<rank>`` (a configured
         JSONL sink therefore interleaves every worker, rank-prefixed,
         on one machine-readable stream)."""
-        log = logging.getLogger(f"znicz_tpu.elastic.w{self.rank}")
+        log = logging.getLogger(f"znicz_tpu.{self.log_tree}.w{self.rank}")
         try:
             with open(self.log_path, "a") as sink:
                 for line in self.proc.stdout:
@@ -200,6 +208,27 @@ class _Worker:
             return time.time() - os.path.getmtime(self.heartbeat_path)
         except OSError:
             return None
+
+
+#: historical private name (pre-ISSUE-13), kept for in-repo references
+_Worker = WorkerProcess
+
+
+def spawn_worker(argv: Sequence[str], *, rank: int, log_path: str,
+                 env: Optional[Mapping[str, str]] = None,
+                 heartbeat_path: str = "",
+                 log_tree: str = "elastic") -> WorkerProcess:
+    """Spawn one supervised worker process (the shared spawn hook):
+    stdout+stderr piped into the :class:`WorkerProcess` log pump, text
+    mode, line buffered.  ``heartbeat_path`` may be "" for workers whose
+    liveness is probed another way (the serving fleet probes HTTP
+    ``/livez`` instead of heartbeat files)."""
+    proc = subprocess.Popen(
+        list(argv), env=dict(env) if env is not None else None,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1)
+    return WorkerProcess(rank, proc, heartbeat_path, log_path,
+                         log_tree=log_tree)
 
 
 class ElasticReport:
@@ -324,7 +353,7 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
         if leaked:
             log.warning(f"elastic: reaping {len(leaked)} live worker(s) "
                         f"on supervisor exit")
-            _teardown(leaked, term_grace, log)
+            teardown_workers(leaked, term_grace, log)
         aggregator.close()
         _probe.elastic_world_size(0)
 
@@ -379,12 +408,10 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                 plan = fault_plans[rank]
                 worker_env[faults.PLAN_ENV_VAR] = (
                     plan if isinstance(plan, str) else plan.to_env())
-            proc = subprocess.Popen(
-                argv, env=worker_env, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True, bufsize=1)
-            fleet.append(_Worker(
-                rank, proc, hb_path,
-                os.path.join(run_dir, f"worker_r{round_no}_w{rank}.log")))
+            fleet.append(spawn_worker(
+                argv, rank=rank, env=worker_env, heartbeat_path=hb_path,
+                log_path=os.path.join(run_dir,
+                                      f"worker_r{round_no}_w{rank}.log")))
         _probe.elastic_world_size(world)
         log.info(f"elastic: round {round_no} up — {world} worker(s)"
                  + (f", resumed from {os.path.basename(resume)}"
@@ -418,7 +445,7 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                 if stragglers:
                     log.info(f"elastic: rank 0 completed; reaping "
                              f"redundant straggler(s) {stragglers}")
-                    _teardown([w for w in fleet if w.rank in stragglers],
+                    teardown_workers([w for w in fleet if w.rank in stragglers],
                               term_grace, log)
                 report.rounds.append({"round": round_no, "world": world,
                                       "outcome": "completed",
@@ -486,7 +513,7 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
         if timed_out:
             log.warning(f"elastic: round {round_no} exceeded "
                         f"{round_timeout}s; restarting")
-        _teardown(fleet, term_grace, log)
+        teardown_workers(fleet, term_grace, log)
         report.rounds.append({
             "round": round_no, "world": world, "outcome": "failed",
             "deaths": deaths, "hung": hung, "timed_out": timed_out})
@@ -529,12 +556,17 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
         round_no += 1
 
 
-def _teardown(fleet: list, term_grace: float, log) -> None:
-    """Kill a round's survivors: SIGTERM (the launcher handler turns it
-    into snapshot-then-exit-143), bounded grace, then SIGKILL.  Every
-    process is reaped."""
+def teardown_workers(fleet: list, term_grace: float, log) -> None:
+    """Kill a fleet's survivors (the shared retire hook): SIGTERM (the
+    launcher handler turns it into snapshot-then-exit-143; serving
+    workers drain and exit 0), bounded grace, then SIGKILL.  Every
+    process is reaped.  A worker whose ``killed`` flag is already set
+    was signaled by the caller and is NOT re-signaled — the serving
+    CLIs restore the default SIGTERM disposition once their drain
+    begins, so a second SIGTERM would kill a worker mid-drain (-15)
+    and lose the requests it had admitted."""
     for w in fleet:
-        if w.proc.poll() is None:
+        if w.proc.poll() is None and not w.killed:
             w.killed = True
             try:
                 w.proc.terminate()

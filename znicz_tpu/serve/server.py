@@ -16,6 +16,10 @@ telemetry needed to operate the thing is one GET away.
     GET  /trace.json    -> this worker's span ring, rank-anchored for
                            the fleet trace merge
     GET  /healthz   -> {"status": "ok"}  (200 while accepting traffic)
+    GET  /livez     -> 200 while the process serves HTTP at all (the
+                       fleet router's restart probe, ISSUE 13)
+    GET  /readyz    -> 200 ready + package fingerprint | 503 draining
+                       (the fleet router's routing gate)
     GET  /          -> model metadata (PredictionServer-compatible)
 
 CLI:  python -m znicz_tpu serve <package.npz> [--port N] [--max-batch N]
@@ -69,6 +73,33 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self._reply(503 if draining else 200,
                     {"status": "draining" if draining else "ok"})
 
+    # -- liveness vs readiness (ISSUE 13) ------------------------------------
+    # The fleet router routes on READINESS and restarts on LIVENESS,
+    # the two questions k8s-style probes keep distinct: a draining or
+    # mid-reboot worker is alive (do not replace it) but must stop
+    # receiving traffic before its drain completes.  /healthz keeps its
+    # historical shape (alive-and-accepting) for existing monitors.
+    def _reply_livez(self) -> None:
+        """``GET /livez``: 200 while the process serves HTTP at all —
+        draining included.  Only a dead listener fails this probe."""
+        self._reply(200, {"status": "ok"})
+
+    def _reply_readyz(self, draining: bool, package=None) -> None:
+        """``GET /readyz``: 200 only while this worker should receive
+        NEW traffic; carries the package fingerprint so a rolling
+        weight update can gate on what the worker actually serves."""
+        doc = {"status": "draining" if draining else "ready"}
+        if package is not None:
+            doc["package"] = package
+        self._reply(503 if draining else 200, doc)
+
+    def _request_id(self) -> str:
+        """The request's trace id: honor an ``X-Request-Id`` minted
+        upstream (the fleet router's router->worker correlation key,
+        ISSUE 13) so every phase span of one request shares a track
+        across processes; mint one only at the true admission edge."""
+        return self.headers.get("X-Request-Id") or next_request_id()
+
     def _reply_prom(self) -> None:
         """``GET /metrics.prom``: the process-global registry in
         Prometheus text — the fleet aggregator's scrape target on BOTH
@@ -99,8 +130,12 @@ class ServeServer(Logger):
     def __init__(self, model, port: int = 0, max_batch: int | None = None,
                  max_wait_ms: float = 2.0, max_queue: int = 128,
                  default_timeout_s: float = 30.0,
-                 warmup: bool = True) -> None:
+                 warmup: bool = True, package_info: dict | None = None) -> None:
         super().__init__()
+        #: content fingerprint of the package this worker booted from
+        #: (utils/naming.py package_fingerprint) — served on /readyz so
+        #: rolling weight updates can verify adoption (ISSUE 13)
+        self.package_info = package_info
         if isinstance(model, BatchEngine):
             if max_batch is not None and max_batch != model.max_batch:
                 raise ValueError(
@@ -131,7 +166,8 @@ class ServeServer(Logger):
     def meta_snapshot(self) -> dict:
         return {"model": self.engine.meta,
                 "n_requests": self.metrics.admitted,
-                "max_batch": self.engine.max_batch}
+                "max_batch": self.engine.max_batch,
+                "package": self.package_info}
 
     # -- HTTP ----------------------------------------------------------------
     def start(self) -> int:
@@ -145,6 +181,11 @@ class ServeServer(Logger):
                     self._reply(200, plane.metrics_snapshot())
                 elif self.path.startswith("/trace.json"):
                     self._reply_trace()
+                elif self.path.startswith("/livez"):
+                    self._reply_livez()
+                elif self.path.startswith("/readyz"):
+                    self._reply_readyz(plane.batcher.draining,
+                                       plane.package_info)
                 elif self.path.startswith("/healthz"):
                     self._reply_healthz(plane.batcher.draining)
                 else:
@@ -154,7 +195,7 @@ class ServeServer(Logger):
                 if not self.path.startswith("/predict"):
                     self._reply(404, {"error": "POST /predict"})
                     return
-                rid = next_request_id()      # minted at HTTP admission
+                rid = self._request_id()     # router-minted or admission
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     doc = json.loads(self.rfile.read(n))
@@ -250,6 +291,9 @@ class GenerateServer(Logger):
                                (queue/prefill/decode/stream, linked by
                                request id on one synthetic track)
         GET  /healthz       -> 200 ok | 503 draining
+        GET  /livez         -> 200 while the process serves HTTP
+        GET  /readyz        -> 200 ready + package fingerprint
+                               | 503 draining (router routing gate)
         GET  /              -> model metadata
 
     ``charmap`` (id -> character, from the LM package) enables text
@@ -258,8 +302,10 @@ class GenerateServer(Logger):
     """
 
     def __init__(self, batcher, charmap=None, port: int = 0,
-                 name: str = "lm") -> None:
+                 name: str = "lm", package_info: dict | None = None) -> None:
         super().__init__()
+        #: /readyz fingerprint, same contract as ServeServer (ISSUE 13)
+        self.package_info = package_info
         self.batcher = batcher
         self.decoder = batcher.decoder
         self.metrics = batcher.metrics
@@ -296,6 +342,7 @@ class GenerateServer(Logger):
                 "slots": self.decoder.batch,
                 "paged": bool(getattr(self.decoder, "paged", False)),
                 "speculative": self.batcher._draft is not None,
+                "package": self.package_info,
                 "n_requests": self.metrics.snapshot()["admitted"]}
 
     def _submit_doc(self, doc: dict, request_id: str | None = None):
@@ -328,6 +375,11 @@ class GenerateServer(Logger):
                     self._reply(200, plane.metrics_snapshot())
                 elif self.path.startswith("/trace.json"):
                     self._reply_trace()
+                elif self.path.startswith("/livez"):
+                    self._reply_livez()
+                elif self.path.startswith("/readyz"):
+                    self._reply_readyz(plane.batcher.draining,
+                                       plane.package_info)
                 elif self.path.startswith("/healthz"):
                     self._reply_healthz(plane.batcher.draining)
                 else:
@@ -395,7 +447,7 @@ class GenerateServer(Logger):
                 if not self.path.startswith("/generate"):
                     self._reply(404, {"error": "POST /generate"})
                     return
-                rid = next_request_id()      # minted at HTTP admission
+                rid = self._request_id()     # router-minted or admission
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     doc = json.loads(self.rfile.read(n))
@@ -622,8 +674,11 @@ def generate_main(argv) -> int:
     batcher = ContinuousBatcher(decoder, max_queue=args.max_queue,
                                 default_timeout_s=args.timeout_s,
                                 draft=draft, spec_k=args.spec_k)
+    from znicz_tpu.utils.naming import package_fingerprint
+
     server = GenerateServer(batcher, charmap=charmap, port=args.port,
-                            name=meta.get("name", "lm"))
+                            name=meta.get("name", "lm"),
+                            package_info=package_fingerprint(args.package))
     port = server.start()
     if args.smoke_test:
         import urllib.request
@@ -656,10 +711,15 @@ def generate_main(argv) -> int:
         done.wait()
     except KeyboardInterrupt:
         pass
+    try:
+        # the handler stays installed THROUGH the drain: restoring the
+        # default first would let a second SIGTERM (an impatient
+        # supervisor, a k8s double-signal) kill the worker mid-drain
+        # and lose every request it had admitted
+        print("generate: draining...")
+        server.stop()
     finally:
         signal.signal(signal.SIGTERM, prev)
-    print("generate: draining...")
-    server.stop()
     return 0
 
 
@@ -700,11 +760,14 @@ def serve_main(argv) -> int:
     except (OSError, ValueError, RuntimeError) as exc:
         print(f"serve: cannot load {args.package!r}: {exc}")
         return 2
+    from znicz_tpu.utils.naming import package_fingerprint
+
     server = ServeServer(backend, port=args.port, max_batch=args.max_batch,
                          max_wait_ms=args.max_wait_ms,
                          max_queue=args.max_queue,
                          default_timeout_s=args.timeout_s,
-                         warmup=not args.no_warmup)
+                         warmup=not args.no_warmup,
+                         package_info=package_fingerprint(args.package))
     port = server.start()
     if args.smoke_test:
         import urllib.request
@@ -732,8 +795,10 @@ def serve_main(argv) -> int:
         done.wait()
     except KeyboardInterrupt:
         pass
+    try:
+        # handler stays installed through the drain (see generate_main)
+        print("serve: draining...")
+        server.stop()
     finally:
         signal.signal(signal.SIGTERM, prev)
-    print("serve: draining...")
-    server.stop()
     return 0

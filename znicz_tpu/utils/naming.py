@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import hashlib
+import os
 import re
 
 
@@ -9,3 +11,22 @@ def slugify(name) -> str:
     """Free-text display name -> filesystem-safe slug (workflow names
     flow into report/summary paths)."""
     return re.sub(r"[^a-z0-9_.-]+", "_", str(name).lower()) or "workflow"
+
+
+def package_fingerprint(path: str) -> dict:
+    """Content identity of one export package file — what a serving
+    worker reports on ``GET /readyz`` and what a rolling weight update
+    gates convergence on (ISSUE 13): two workers serve the same weights
+    iff their fingerprints match, whatever paths the bytes arrived by.
+
+    Deliberately stdlib-only (the fleet modules follow federation.py's
+    convention of never importing jax themselves) and
+    content-addressed: sha256 over the file bytes, with the basename
+    and size as human-readable corroboration."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return {"sha256": h.hexdigest(),
+            "file": os.path.basename(path),
+            "bytes": os.path.getsize(path)}
