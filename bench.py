@@ -1014,6 +1014,193 @@ def bench_fleet(n_requests=24, max_new=8, flood_clients=8):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_train_while_serve(n_requests=16, max_new=8):
+    """Train-while-serve scenario (ISSUE 14), over REAL processes: the
+    same greedy generation is timed through the fleet router with the
+    trainer IDLE, with the trainer CO-RESIDENT (supervised, consuming
+    the live feedback spool on the same box), and MID-ROLLOUT (while
+    the publish-triggered zero-downtime update replaces workers) —
+    the three serving-latency regimes the continuous-learning loop
+    creates.  A second line reports publish-to-adopted latency (the
+    manifest wall stamp to fleet convergence).  Ledger equality and
+    steady-state compile delta 0 are asserted AFTER the lines land."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from znicz_tpu.fleet import FleetRouter, WorkerPool
+    from znicz_tpu.fleet.rollout import RollingUpdate
+    from znicz_tpu.learn.publish import latest_manifest
+    from znicz_tpu.parallel.transformer import init_params
+    from znicz_tpu.resilience.elastic import run_elastic
+    from znicz_tpu.resilience.supervisor import SupervisorPolicy
+    from znicz_tpu.utils.export import export_lm
+
+    tmp = tempfile.mkdtemp(prefix="znicz_bench_learn_")
+    pool = router = None
+    trainer_box: dict = {}
+    try:
+        charmap = list("abcdefgh .,!?")
+        params = init_params(np.random.default_rng(11), 2, 32, 4, 64,
+                             len(charmap))
+        pkg = os.path.join(tmp, "lm.npz")
+        export_lm(params, pkg, heads=4, charmap=charmap,
+                  name="bench_lm")
+        spool = os.path.join(tmp, "spool")
+        pub = os.path.join(tmp, "publish")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   ZNICZ_TPU_COMPILE_CACHE="off")
+        pool = WorkerPool(
+            pkg, plane="generate", env=env,
+            worker_args=("--slots", "2", "--max-len", "64",
+                         "--feedback-spool", spool),
+            run_dir=os.path.join(tmp, "fleet"))
+        pool.spawn()
+        pool.spawn()
+        if not pool.wait_all_ready(timeout_s=240):
+            raise RuntimeError(f"fleet workers never ready: "
+                               f"{pool.snapshot()}")
+        pool.start_probes()
+        router = FleetRouter(pool)
+        rollout = RollingUpdate(pool)
+        router.attach_rollout(rollout)
+        base = f"http://127.0.0.1:{router.start()}"
+
+        def one_request() -> float:
+            body = _json.dumps({"prompt": "ab", "max_tokens": max_new,
+                                "timeout_s": 60}).encode()
+            req = urllib.request.Request(
+                base + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=120) as r:
+                lines = [_json.loads(raw) for raw in r]
+            if not lines or not lines[-1].get("done") or \
+                    "error" in lines[-1]:
+                raise RuntimeError(f"bench stream did not complete: "
+                                   f"{lines}")
+            return time.perf_counter() - t0
+
+        def timed(n: int) -> np.ndarray:
+            return np.asarray([one_request()
+                               for _ in range(n + 3)][3:]) * 1000.0
+
+        # -- arm 1: trainer idle (also seeds the feedback spool) -----
+        idle = timed(n_requests)
+
+        # -- arm 2: trainer co-resident ------------------------------
+        trainer_argv = [
+            "znicz_tpu/learn/trainer_workflow.py",
+            "-o", f"root.learn.spool_dir={spool}",
+            "-o", f"root.learn.package={pkg}",
+            "-o", f"root.learn.publish_dir={pub}",
+            "-o", "root.learn.publish_every=4",
+            "-o", "root.learn.max_epochs=4",
+            "-o", "root.learn.records_per_epoch=6",
+            "-o", "root.learn.seq_len=8",
+            "-o", "root.learn.minibatch_size=4",
+            "-o", "root.learn.wait_timeout_s=300",
+            "--random-seed", "11"]
+
+        def train() -> None:
+            try:
+                trainer_box["report"] = run_elastic(
+                    trainer_argv, os.path.join(tmp, "snaps"),
+                    workers=1, spmd=False, env=env,
+                    run_dir=os.path.join(tmp, "trainer"),
+                    policy=SupervisorPolicy(max_restarts=1))
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                trainer_box["error"] = exc
+
+        trainer = threading.Thread(target=train, daemon=True)
+        trainer.start()
+        time.sleep(2.0)               # past the trainer's jax boot
+        co = timed(n_requests)
+
+        # -- arm 3: mid-rollout (publish-triggered) ------------------
+        deadline = time.monotonic() + 300
+        manifest = None
+        while time.monotonic() < deadline:
+            if "error" in trainer_box:
+                raise RuntimeError(f"trainer failed: "
+                                   f"{trainer_box['error']!r}")
+            manifest = latest_manifest(pub)
+            if manifest is not None:
+                break
+            one_request()             # keep the spool fed meanwhile —
+            time.sleep(0.2)           # THROTTLED: an unthrottled loop
+            #                           starves the co-resident trainer
+            #                           of the box (the learn smoke
+            #                           lesson) and the publish never
+            #                           comes
+        if manifest is None:
+            raise RuntimeError("trainer never published")
+        rollout.start(manifest["package"])
+        roll_lats = []
+        while rollout.rolling and len(roll_lats) < 400:
+            roll_lats.append(one_request())
+        report = rollout.join()
+        adopted_s = max(0.0, time.time() - float(manifest["ts"]))
+        roll = np.asarray(roll_lats) * 1000.0 if roll_lats else \
+            np.asarray([0.0])
+        _emit("train_while_serve_p95_ms",
+              float(np.percentile(co, 95)), unit="ms",
+              lower_is_better=True,
+              idle_p95_ms=round(float(np.percentile(idle, 95)), 2),
+              rollout_p95_ms=round(float(np.percentile(roll, 95)), 2),
+              co_resident_overhead_p50_ms=round(
+                  float(np.percentile(co, 50) -
+                        np.percentile(idle, 50)), 2),
+              rollout_requests=len(roll_lats),
+              requests=n_requests, cpu=True)
+        _emit("learn_publish_to_adopted_sec", adopted_s,
+              unit="seconds", lower_is_better=True,
+              trend_valid=report.get("state") == "done",
+              epoch=manifest.get("epoch"), cpu=True)
+        # asserted AFTER the lines land (the scenario contract)
+        assert report.get("state") == "done", \
+            f"publish-triggered rollout failed: {report}"
+        trainer.join(timeout=240)
+        assert trainer_box.get("report") is not None and \
+            trainer_box["report"].completed, \
+            f"trainer did not complete: {trainer_box}"
+        snap = router.snapshot()
+        assert snap["admitted"] == snap["completed"] + \
+            snap["failed"] + snap["client_gone"], \
+            f"router ledger does not close: {snap}"
+        pool.probe_once()
+        shas = {(w.fingerprint or {}).get("sha256")
+                for w in pool.workers()}
+        assert shas == {manifest["fingerprint"]["sha256"]}, \
+            f"fleet not converged on the published package: " \
+            f"{pool.snapshot()}"
+        # steady state: fresh traffic compiles nothing
+        def compile_counts():
+            out = []
+            for w in pool.workers():
+                with urllib.request.urlopen(w.base + "/metrics",
+                                            timeout=15) as r:
+                    out.append(_json.loads(r.read())["decoder"]
+                               ["compile_count"])
+            return out
+
+        before = compile_counts()
+        for _ in range(3):
+            one_request()
+        assert before == compile_counts(), \
+            "steady-state decode recompiled after the adoption"
+    finally:
+        if router is not None:
+            router.stop()
+        if pool is not None:
+            pool.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
                          n_valid=2560, hidden=512, reps=2):
     """Input-pipeline scenario (ISSUE 4): sync vs prefetch=2 through the
@@ -1423,6 +1610,17 @@ def child_main(mode: str) -> None:
         _enable_compile_cache()
         bench_fleet()
         return
+    if mode == "train_while_serve":
+        # continuous-learning scenario (ISSUE 14): serving p95 with
+        # the trainer idle vs co-resident vs mid-rollout, plus
+        # publish-to-adopted latency — real worker + trainer
+        # subprocesses; the bench child itself only routes
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_train_while_serve()
+        return
     if mode == "metrics_overhead":
         # telemetry-plane scenario: CPU by design (measures the
         # observe instrumentation through the real run loop)
@@ -1566,7 +1764,8 @@ def main():
     # serving-plane / input-pipeline / metrics-overhead scenarios: their
     # own CPU children (independent of the chip pool), BEFORE the final
     # flagship re-emit so the driver's last-line contract is untouched
-    for extra_mode in ("serve", "generate", "fleet", "pipeline",
+    for extra_mode in ("serve", "generate", "fleet",
+                       "train_while_serve", "pipeline",
                        "metrics_overhead", "compile_latency"):
         # compile_latency's own legs each budget up to CPU_TIMEOUT (two
         # fresh-process probes + the AOT export leg) — its OUTER timeout
@@ -1576,9 +1775,11 @@ def main():
         # arm primes then times), so it gets a doubled budget too.
         # fleet boots real worker subprocesses (one cold + one
         # autoscaled) on top of its request sweeps — doubled budget
-        # like generate
+        # like generate; train_while_serve boots 2 workers + a
+        # supervised trainer and waits out a publish + rollout
         budget = 4 * CPU_TIMEOUT if extra_mode == "compile_latency" \
-            else 2 * CPU_TIMEOUT if extra_mode in ("generate", "fleet") \
+            else 2 * CPU_TIMEOUT if extra_mode in (
+                "generate", "fleet", "train_while_serve") \
             else CPU_TIMEOUT
         extra_results, note = _run_child(extra_mode, budget,
                                          platform="cpu")

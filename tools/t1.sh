@@ -78,6 +78,17 @@ if ! timeout -k 5 400 env JAX_PLATFORMS=cpu python tools/fleet_router_smoke.py; 
          "fleet_router_smoke lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 14 smoke: train-while-serve — the real `learn` CLI closes the
+# whole loop in fresh processes: 2 serve workers feed the spool, 1
+# supervised trainer consumes it and publishes, the bridge rolls the
+# fleet; asserts an adopted publish + fleet-wide new fingerprint +
+# closed router ledger (docs/LEARNING.md; ZNICZ_TPU_COMPILE_CACHE=off
+# per the PR 9 box note)
+if ! timeout -k 5 700 env JAX_PLATFORMS=cpu python tools/learn_smoke.py; then
+    echo "tools/t1.sh: train-while-serve smoke FAILED (see learn_smoke" \
+         "lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 # ISSUE 9 smoke: elastic kill-and-resume — 2 CPU worker processes, the
 # snapshot writer SIGKILL'd at a seeded step, fleet resumes at world
 # size 1; asserts completion + >= 1 flight artifact + resumes counter

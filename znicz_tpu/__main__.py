@@ -10,6 +10,8 @@ Usage:
     python -m znicz_tpu aot <package.npz> [--max-batch N] [-o out.npz]
     python -m znicz_tpu fleet <package.npz> [--workers N --port P
                                   --autoscale] [-- worker flags ...]
+    python -m znicz_tpu learn <lm_package.npz> [--workers N --port P
+                                  --publish-every K] [-- worker flags ...]
     python -m znicz_tpu trace <out.json> <workflow.py> [config.py ...]
     python -m znicz_tpu trace --fleet -o <out.json> <src> [<src> ...]
     python -m znicz_tpu flight <flight_artifact.json> [--json]
@@ -239,6 +241,13 @@ def main(argv=None) -> int:
         from znicz_tpu.fleet.cli import fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "learn":
+        # train-while-serve (ISSUE 14): serving fleet + spool-fed
+        # trainer under the elastic supervisor + adoption bridge — the
+        # VELES master-loop closed on live traffic (docs/LEARNING.md)
+        from znicz_tpu.learn.cli import learn_main
+
+        return learn_main(argv[1:])
     if argv and argv[0] == "aot":
         # compile-latency plane (ISSUE 7): embed ahead-of-time serving
         # executables into a forward package so `serve` boots with zero

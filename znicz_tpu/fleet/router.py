@@ -119,8 +119,15 @@ class FleetRouter(Logger):
 
     def attach_rollout(self, rollout) -> None:
         """Mount a :class:`~znicz_tpu.fleet.rollout.RollingUpdate` on
-        the admin endpoints (GET/POST /rollout)."""
+        the admin endpoints (GET/POST /rollout) and surface its state
+        machine top-level in ``/fleet/status.json`` (ISSUE 14
+        satellite — the learn bridge and operators gate adoption on
+        one document)."""
         self.rollout = rollout
+        self.pool.aggregator.register_status_provider(
+            "rollout",
+            lambda: {k: v for k, v in rollout.status().items()
+                     if k != "steps"})
 
     # -- ledger --------------------------------------------------------------
     def _count(self, key: str, n: int = 1) -> None:

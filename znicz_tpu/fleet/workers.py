@@ -186,6 +186,12 @@ class WorkerPool(Logger):
         #: (the router mounts its /fleet/* endpoints on this)
         self.aggregator = _federation.FleetAggregator(
             stale_s=max(10.0 * probe_interval_s, 5.0))
+        # ISSUE 14 satellite: /fleet/status.json surfaces the fleet's
+        # CURRENT package fingerprint + convergence top-level, so the
+        # learn-plane adoption gate and operators read one field
+        # instead of folding per-worker /readyz answers
+        self.aggregator.register_status_provider("package",
+                                                 self.package_status)
         self.replacements = 0
 
     # -- package (rollout flips this) ----------------------------------------
@@ -277,6 +283,21 @@ class WorkerPool(Logger):
                 "plane": self.plane,
                 "replacements": self.replacements,
                 "workers": [w.snapshot() for w in self.workers()]}
+
+    def package_status(self) -> dict:
+        """The ``/fleet/status.json`` top-level ``"package"`` block:
+        what the fleet SHOULD serve (the pool's expected fingerprint)
+        and whether every non-retiring worker's last probe agrees —
+        the one field a rolling adoption gates on."""
+        with self._lock:
+            package, fp = self.package, self.expected_fingerprint
+        workers = [w for w in self.workers() if not w.retiring]
+        converged = bool(workers) and all(
+            (w.fingerprint or {}).get("sha256") == fp.get("sha256")
+            for w in workers)
+        return {"package": package, "fingerprint": fp,
+                "converged": converged,
+                "workers_ready": self.ready_count()}
 
     # -- probing -------------------------------------------------------------
     def probe_worker(self, worker: FleetWorker) -> None:

@@ -1,0 +1,32 @@
+"""Continuous learning on live traffic (ISSUE 14) — the VELES
+master-loop closed end to end: serving workers append accepted traffic
+to a crash-safe feedback spool, a supervised trainer consumes it as a
+streaming dataset, publishes a fresh LM package every K epochs, and an
+adoption bridge rolls the serving fleet onto it with zero lost
+requests.
+
+Pieces (each importable on its own; the spool never imports jax, so
+serving workers stay as light as before):
+
+- :mod:`znicz_tpu.learn.spool` — the bounded multi-writer JSONL spool
+  (:class:`FeedbackSpool`) and its exactly-once cursor reader
+  (:class:`SpoolReader`);
+- :mod:`znicz_tpu.loader.spool` — ``SpoolSequenceLoader``, the
+  streaming dataset loader tailing the spool into the async
+  ``BatchPrefetcher`` with a snapshot-durable consumption cursor;
+- :mod:`znicz_tpu.learn.publish` — the every-K-epochs LM export unit
+  and the atomic publish manifest;
+- :mod:`znicz_tpu.learn.bridge` — the publish-to-rollout adoption
+  bridge over the ISSUE 13 :class:`RollingUpdate`;
+- :mod:`znicz_tpu.learn.cli` — ``python -m znicz_tpu learn <pkg>``,
+  the one-command assembly (serve fleet + trainer under the elastic
+  supervisor + bridge).
+
+docs/LEARNING.md is the operator's guide.
+"""
+
+from znicz_tpu.learn.spool import (FeedbackSpool, SpoolReader,  # noqa: F401
+                                   SpoolTimeout, initial_cursor)
+from znicz_tpu.learn.publish import (latest_manifest,  # noqa: F401
+                                     publish_package)
+from znicz_tpu.learn.bridge import AdoptionBridge  # noqa: F401

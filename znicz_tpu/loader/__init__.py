@@ -20,6 +20,7 @@ from znicz_tpu.loader import image     # noqa: F401  (registry population)
 from znicz_tpu.loader import pickles   # noqa: F401  (registry population)
 from znicz_tpu.loader import text      # noqa: F401  (registry population)
 from znicz_tpu.loader import sequence  # noqa: F401  (registry population)
+from znicz_tpu.loader import spool     # noqa: F401  (registry population)
 from znicz_tpu.loader.mnist import MnistLoader
 from znicz_tpu.loader.image import FileImageLoader, FullBatchImageLoader
 from znicz_tpu.loader.pickles import PicklesImageLoader
@@ -27,11 +28,12 @@ from znicz_tpu.loader.text import TextBagOfWordsLoader
 from znicz_tpu.loader.interactive import InteractiveLoader
 from znicz_tpu.loader.restful import PredictionServer
 from znicz_tpu.loader.sequence import CharSequenceLoader
+from znicz_tpu.loader.spool import SpoolSequenceLoader
 
 __all__ = ["Loader", "FullBatchLoader", "FullBatchLoaderMSE",
            "MnistLoader", "FileImageLoader", "FullBatchImageLoader",
            "PicklesImageLoader", "TextBagOfWordsLoader",
-           "CharSequenceLoader",
+           "CharSequenceLoader", "SpoolSequenceLoader",
            "InteractiveLoader", "PredictionServer",
            "NORMALIZER_REGISTRY", "normalizer_factory",
            "TEST", "VALID", "TRAIN", "CLASS_NAMES",

@@ -402,6 +402,14 @@ class FleetAggregator(Logger):
         #: reduce (family sums, label filters, bucket-delta quantiles)
         #: works fleet-wide unchanged
         self.tower = Watchtower(capacity=capacity, registry=self)
+        #: top-level ``/fleet/status.json`` blocks from the planes that
+        #: own fleet-wide facts (ISSUE 14 satellite): the worker pool
+        #: registers ``"package"`` (current fingerprint + convergence),
+        #: the router's rollout registers ``"rollout"``, the learn
+        #: bridge registers ``"learn"`` — so operators and the adoption
+        #: gate read ONE document instead of folding per-worker /readyz
+        #: answers themselves
+        self._status_providers: dict = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
         self.port = 0
@@ -627,14 +635,40 @@ class FleetAggregator(Logger):
                             for r, w in self.workers_snapshot().items()},
                 "flat": flat}
 
+    # -- status providers (ISSUE 14 satellite) -------------------------------
+    def register_status_provider(self, key: str, fn: Callable[[], dict]
+                                 ) -> None:
+        """Merge ``fn()`` into ``/fleet/status.json`` under top-level
+        ``key`` — fleet-wide facts (package fingerprint, rollout state,
+        learn-plane adoption) surface in one document.  A provider
+        failure degrades to an ``{"error": ...}`` block, never a 500."""
+        with self._lock:
+            self._status_providers[str(key)] = fn
+
+    def unregister_status_provider(self, key: str, fn=None) -> None:
+        """Remove ``key`` (only if still ``fn``, when given — the
+        newest-registrant-wins convention the flight planes use)."""
+        with self._lock:
+            if fn is None or self._status_providers.get(key) is fn:
+                self._status_providers.pop(str(key), None)
+
     def status_doc(self) -> dict:
         """``GET /fleet/status.json``: liveness + the fleet
-        watchtower's rule states and retained-series digest."""
+        watchtower's rule states, plus every registered provider's
+        top-level block (``package``/``rollout``/``learn``)."""
         self.refresh()
-        return {"workers": {r: {k: v for k, v in w.items()
-                                if k != "flat"}
-                            for r, w in self.workers_snapshot().items()},
-                "watchtower": self.tower.snapshot()}
+        with self._lock:
+            providers = dict(self._status_providers)
+        doc = {"workers": {r: {k: v for k, v in w.items()
+                               if k != "flat"}
+                           for r, w in self.workers_snapshot().items()},
+               "watchtower": self.tower.snapshot()}
+        for key, fn in providers.items():
+            try:
+                doc[key] = fn()
+            except Exception as exc:  # noqa: BLE001 — one dead plane
+                doc[key] = {"error": repr(exc)}   # must not 500 status
+        return doc
 
     def trace_doc(self) -> dict:
         """``GET /fleet/trace.json``: the HTTP sources' live tracer

@@ -280,7 +280,9 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
                 boot_timeout: Optional[float] = None,
                 round_timeout: Optional[float] = None,
                 fleet_port: Optional[int] = None,
-                metrics_interval: float = 1.0) -> ElasticReport:
+                metrics_interval: float = 1.0,
+                stop_event: Optional[threading.Event] = None
+                ) -> ElasticReport:
     """Supervise an elastic worker fleet to completion.
 
     ``worker_argv`` is the CLI tail after ``python -m znicz_tpu`` (the
@@ -305,6 +307,11 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
     runs (None = the aggregator still ingests worker snapshots so
     flight artifacts embed them, just no listener);
     ``metrics_interval`` is the workers' snapshot-export cadence.
+    ``stop_event`` is a cooperative shutdown hook (ISSUE 14: the learn
+    CLI supervises its trainer on a thread and must be able to retire
+    it on SIGTERM): once set, the in-flight round is torn down
+    gracefully (SIGTERM = snapshot-then-exit) and the report returns
+    with a ``"stopped"`` round instead of a restart.
 
     Returns an :class:`ElasticReport`; raises :class:`ElasticExhausted`
     when the budget is spent.
@@ -343,7 +350,7 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
             spmd, coordinator_host, base_env, fault_plans, poll_s,
             term_grace, heartbeat_interval, heartbeat_timeout,
             progress_timeout, boot_timeout, round_timeout, report, log,
-            current, aggregator, metrics_interval)
+            current, aggregator, metrics_interval, stop_event)
     finally:
         # ANY exit — completion, ElasticExhausted, KeyboardInterrupt,
         # a spawn OSError halfway through a round — must not orphan
@@ -364,11 +371,17 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                       heartbeat_interval, heartbeat_timeout,
                       progress_timeout, boot_timeout, round_timeout,
                       report, log, current, aggregator,
-                      metrics_interval) -> ElasticReport:
+                      metrics_interval, stop_event=None) -> ElasticReport:
     """:func:`run_elastic`'s round loop, split out so the caller's
     try/finally can guarantee teardown of ``current`` on ANY exit."""
     round_no = 0
     while True:
+        if stop_event is not None and stop_event.is_set():
+            # stop landed between rounds (e.g. during backoff): do not
+            # spawn a round just to tear it down
+            report.rounds.append({"round": round_no, "world": 0,
+                                  "outcome": "stopped"})
+            return report
         world = schedule[min(round_no, len(schedule) - 1)]
         resume = find_latest_valid_snapshot(
             snap_dir, prefix, rejected=report.rejected_snapshots)
@@ -423,6 +436,16 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
         timed_out = False
         while True:
             now = time.monotonic()
+            if stop_event is not None and stop_event.is_set():
+                # cooperative shutdown (ISSUE 14): SIGTERM the round —
+                # the launcher handler turns that into one final
+                # snapshot — and return without a restart
+                log.info("elastic: stop requested; retiring the round")
+                teardown_workers(fleet, term_grace, log)
+                report.rounds.append({"round": round_no, "world": world,
+                                      "outcome": "stopped"})
+                report.world_size = world
+                return report
             alive = [w for w in fleet if w.proc.poll() is None]
             if fleet[0].proc.poll() == 0:
                 # rank 0 — the snapshot writer and history owner —

@@ -130,12 +130,16 @@ class ServeServer(Logger):
     def __init__(self, model, port: int = 0, max_batch: int | None = None,
                  max_wait_ms: float = 2.0, max_queue: int = 128,
                  default_timeout_s: float = 30.0,
-                 warmup: bool = True, package_info: dict | None = None) -> None:
+                 warmup: bool = True, package_info: dict | None = None,
+                 feedback=None) -> None:
         super().__init__()
         #: content fingerprint of the package this worker booted from
         #: (utils/naming.py package_fingerprint) — served on /readyz so
         #: rolling weight updates can verify adoption (ISSUE 13)
         self.package_info = package_info
+        #: learn-plane spool (ISSUE 14): answered predictions append as
+        #: labeled (input, output) pairs with request-id provenance
+        self.feedback = feedback
         if isinstance(model, BatchEngine):
             if max_batch is not None and max_batch != model.max_batch:
                 raise ValueError(
@@ -222,7 +226,15 @@ class ServeServer(Logger):
                 except Exception as exc:  # noqa: BLE001 — engine failure
                     self._reply(500, {"error": str(exc)})
                     return
-                self._reply(200, {"output": np.asarray(out).tolist()},
+                out_rows = np.asarray(out).tolist()
+                if plane.feedback is not None:
+                    try:
+                        plane.feedback.append_predict(
+                            rid, doc["input"], out_rows)
+                    except Exception as exc:  # noqa: BLE001 — feedback
+                        plane.warning(     # must never fail a request
+                            f"feedback append failed: {exc!r}")
+                self._reply(200, {"output": out_rows},
                             headers=(("X-Request-Id", rid),))
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
@@ -570,6 +582,11 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "mode off-TPU)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the cache buckets")
+    p.add_argument("--feedback-spool", default=None, metavar="DIR",
+                   help="append every COMPLETED generation (prompt + "
+                        "continuation + request id) to this learn-"
+                        "plane spool directory (docs/LEARNING.md) — "
+                        "the train-while-serve feedback source")
     p.add_argument("--smoke-test", action="store_true",
                    help="start, stream one self-request, exit (CI "
                         "probe)")
@@ -671,9 +688,17 @@ def generate_main(argv) -> int:
                            else None)
             if draft is not None:
                 draft.warmup()
+    on_complete = None
+    if args.feedback_spool:
+        # the learn plane's traffic tap (ISSUE 14): completed
+        # generations land in the crash-safe spool the trainer tails
+        from znicz_tpu.learn.spool import FeedbackSpool
+
+        on_complete = FeedbackSpool(args.feedback_spool).append_generate
     batcher = ContinuousBatcher(decoder, max_queue=args.max_queue,
                                 default_timeout_s=args.timeout_s,
-                                draft=draft, spec_k=args.spec_k)
+                                draft=draft, spec_k=args.spec_k,
+                                on_complete=on_complete)
     from znicz_tpu.utils.naming import package_fingerprint
 
     server = GenerateServer(batcher, charmap=charmap, port=args.port,
@@ -747,6 +772,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-aot", action="store_true",
                    help="ignore embedded ahead-of-time executables and "
                         "JIT every bucket (docs/COMPILE.md)")
+    p.add_argument("--feedback-spool", default=None, metavar="DIR",
+                   help="append every answered prediction (labeled "
+                        "input/output pair + request id) to this "
+                        "learn-plane spool directory (docs/LEARNING.md)")
     p.add_argument("--smoke-test", action="store_true",
                    help="start, serve one self-request, exit (CI probe)")
     return p
@@ -762,12 +791,18 @@ def serve_main(argv) -> int:
         return 2
     from znicz_tpu.utils.naming import package_fingerprint
 
+    feedback = None
+    if args.feedback_spool:
+        from znicz_tpu.learn.spool import FeedbackSpool
+
+        feedback = FeedbackSpool(args.feedback_spool)
     server = ServeServer(backend, port=args.port, max_batch=args.max_batch,
                          max_wait_ms=args.max_wait_ms,
                          max_queue=args.max_queue,
                          default_timeout_s=args.timeout_s,
                          warmup=not args.no_warmup,
-                         package_info=package_fingerprint(args.package))
+                         package_info=package_fingerprint(args.package),
+                         feedback=feedback)
     port = server.start()
     if args.smoke_test:
         import urllib.request
