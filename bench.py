@@ -1279,6 +1279,77 @@ def bench_input_pipeline(epochs=3, minibatch=256, n_train=10240,
         "prefetched metric history diverged from the synchronous run"
 
 
+def bench_zero_sharding(epochs=3, minibatch=32, n_train=640, n_valid=0,
+                        hidden=128):
+    """ZeRO shard_params scenario (ISSUE 15), CPU by design on a forced
+    8-virtual-device platform (it measures the sharding machinery +
+    accounting, not the chip; the child sets the platform before jax
+    boots): the SAME seeded adam workflow runs replicated vs
+    shard_params across dp mesh sizes, recording per-chip persistent
+    state bytes (the znicz_zero_* gauges) and wall-clock throughput.
+    The line lands first; the memory contract (per-chip bytes <= 1/n +
+    padding) and the seeded-history parity are ASSERTED after it
+    flushes, so a violation still records the measurement but fails the
+    scenario loudly (nonzero child exit)."""
+    import time as _time
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.observe import registry
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    def gauge(name):
+        return registry.REGISTRY.get(name).labels(unit="FusedStep").get()
+
+    def run_once(n_dev, shard_params):
+        prng.seed_all(31)
+        w = build_fused(max_epochs=epochs, layers=(hidden,),
+                        minibatch_size=minibatch, n_train=n_train,
+                        n_valid=n_valid, mesh=data_parallel_mesh(n_dev),
+                        optimizer="adam", shard_params=shard_params)
+        w.initialize(device=TPUDevice())
+        t0 = _time.perf_counter()
+        w.run()
+        dt = _time.perf_counter() - t0
+        hist = [dict(h) for h in w.decision.metrics_history]
+        bytes_per_chip = int(gauge("znicz_zero_param_bytes") +
+                             gauge("znicz_zero_opt_state_bytes"))
+        n_sharded = sum(1 for leaf in w.step._params
+                        for k in leaf if w.step._leaf_sharded(k))
+        w.stop()
+        sps = (n_train + n_valid) * epochs / dt
+        return sps, bytes_per_chip, hist, n_sharded
+
+    matrix, violations = {}, []
+    headline_sps = 0.0
+    for n_dev in (2, 4, 8):
+        rep_sps, rep_bytes, rep_hist, _ = run_once(n_dev, False)
+        sp_sps, sp_bytes, sp_hist, n_sharded = run_once(n_dev, True)
+        matrix[f"dp{n_dev}"] = {
+            "replicated": {"samples_per_sec": round(rep_sps, 1),
+                           "state_bytes_per_chip": rep_bytes},
+            "shard_params": {"samples_per_sec": round(sp_sps, 1),
+                             "state_bytes_per_chip": sp_bytes},
+            "mem_ratio": round(sp_bytes / rep_bytes, 4),
+            "hist_equal": sp_hist == rep_hist,
+        }
+        eps = 4 * (n_dev - 1) * n_sharded
+        if sp_bytes > rep_bytes / n_dev + eps:
+            violations.append(f"dp{n_dev}: {sp_bytes}B > "
+                              f"{rep_bytes}/{n_dev}+{eps}B")
+        if sp_hist != rep_hist:
+            violations.append(f"dp{n_dev}: seeded history diverged")
+        if n_dev == 8:
+            headline_sps = sp_sps
+    _emit("zero_shard_params_dp8_samples_per_sec", headline_sps,
+          cpu=True, mesh_sizes=matrix,
+          mem_ratio_dp8=matrix["dp8"]["mem_ratio"])
+    # AFTER the emit so the measurement always lands: a broken memory
+    # contract or history divergence must fail the scenario loudly
+    assert not violations, "; ".join(violations)
+
+
 def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
                            n_valid=640, hidden=256, pairs=20):
     """ISSUE 5 scenario: the telemetry plane's cost on the REAL
@@ -1630,6 +1701,19 @@ def child_main(mode: str) -> None:
         _enable_compile_cache()
         bench_metrics_overhead()
         return
+    if mode == "zero_sharding":
+        # ZeRO shard_params scenario: a FORCED 8-virtual-device CPU
+        # platform (must land in the env before the first jax backend
+        # init) so dp mesh sizes 2/4/8 exercise the real sharded layout
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_zero_sharding()
+        return
     if mode == "compile_latency":
         # compile-latency scenario: orchestrates two compile_probe
         # children over a fresh shared cache dir + an AOT boot leg
@@ -1766,6 +1850,7 @@ def main():
     # flagship re-emit so the driver's last-line contract is untouched
     for extra_mode in ("serve", "generate", "fleet",
                        "train_while_serve", "pipeline",
+                       "zero_sharding",
                        "metrics_overhead", "compile_latency"):
         # compile_latency's own legs each budget up to CPU_TIMEOUT (two
         # fresh-process probes + the AOT export leg) — its OUTER timeout

@@ -89,6 +89,16 @@ if ! timeout -k 5 700 env JAX_PLATFORMS=cpu python tools/learn_smoke.py; then
          "lines above)" >&2
     [ $rc -eq 0 ] && rc=1
 fi
+# ISSUE 15 smoke: ZeRO shard_params — dp(4)+shard_params(adam) on a
+# forced 4-device CPU mesh must read per-chip znicz_zero_* bytes at
+# ~1/4 of the replicated run's with an identical seeded metric history
+# (docs/TUNING.md "ZeRO modes"; ZNICZ_TPU_COMPILE_CACHE=off per the
+# PR 9 box note)
+if ! timeout -k 5 240 env JAX_PLATFORMS=cpu python tools/zero_smoke.py; then
+    echo "tools/t1.sh: ZeRO shard_params smoke FAILED (see zero_smoke" \
+         "lines above)" >&2
+    [ $rc -eq 0 ] && rc=1
+fi
 # ISSUE 9 smoke: elastic kill-and-resume — 2 CPU worker processes, the
 # snapshot writer SIGKILL'd at a seeded step, fleet resumes at world
 # size 1; asserts completion + >= 1 flight artifact + resumes counter

@@ -105,7 +105,7 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
                 minibatch_size=64, n_train=640, n_valid=192,
                 mesh=None, loader=None, optimizer="sgd",
                 optimizer_config=None, shard_update=False,
-                accumulate_steps=1, ema_decay=None,
+                shard_params=False, accumulate_steps=1, ema_decay=None,
                 pipeline_depth=None) -> NNWorkflow:
     """TPU-native shape: Repeater -> Loader -> FusedTrainStep -> Decision."""
     w = NNWorkflow(name="MnistFC-fused")
@@ -117,6 +117,7 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
         w, forwards=forwards, evaluator=ev, gds=gds, loader=w.loader,
         mesh=mesh, optimizer=optimizer,
         optimizer_config=optimizer_config, shard_update=shard_update,
+        shard_params=shard_params,
         accumulate_steps=accumulate_steps, ema_decay=ema_decay,
         name="FusedStep")
     dec = w.decision = DecisionGD(w, max_epochs=max_epochs)

@@ -305,6 +305,42 @@ def compile_cache_stats() -> tuple:
     return int(_CACHE_HITS.get()), int(_CACHE_MISSES.get())
 
 
+# -- ZeRO sharding plane (ISSUE 15) ------------------------------------------
+
+_ZERO_PARAM_BYTES = _reg.gauge(
+    "znicz_zero_param_bytes",
+    "per-chip bytes of persistent model parameters held by a fused "
+    "train step (full when replicated; 1/n flat shards + padding under "
+    "shard_params)", labelnames=("unit",))
+_ZERO_OPT_BYTES = _reg.gauge(
+    "znicz_zero_opt_state_bytes",
+    "per-chip bytes of persistent optimizer/EMA state held by a fused "
+    "train step (1/n flat shards under shard_update/shard_params)",
+    labelnames=("unit",))
+_ZERO_GATHERED = _reg.counter(
+    "znicz_zero_gathered_bytes_total",
+    "bytes all-gathered on demand to materialize full weights for a "
+    "forward/backward dispatch under shard_params",
+    labelnames=("unit",))
+
+
+def zero_memory(unit: str, param_bytes: int, opt_bytes: int) -> None:
+    """Per-chip persistent-state accounting, set once per step build.
+    Recorded even while probes are disabled (the compile_cache_event
+    precedent): the memory contract must stay assertable through a
+    bench's bare arm, and a step build is never on the per-signal hot
+    path."""
+    _ZERO_PARAM_BYTES.labels(unit=unit).set(float(param_bytes))
+    _ZERO_OPT_BYTES.labels(unit=unit).set(float(opt_bytes))
+
+
+def zero_gather_counter(unit: str):
+    """Cached child handle for the per-dispatch gathered-bytes counter
+    (the step increments it on its hot path — one ``inc`` per dispatch,
+    gated on :func:`enabled` by the caller)."""
+    return _ZERO_GATHERED.labels(unit=unit)
+
+
 # -- pipeline plane ----------------------------------------------------------
 
 _BYTES_STAGED = _reg.counter(

@@ -52,11 +52,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from znicz_tpu.parallel.compat import shard_map
+# hoisted out of the program-build path (_apply_update used to import it
+# per trace); the module is jax-only, so the import is always safe here
+from znicz_tpu.parallel import zero
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.config import root
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.observe import probe as _probe
 from znicz_tpu.ops import sgd
 from znicz_tpu.resilience.faults import poison_hook
 from znicz_tpu.units.all2all import All2AllSoftmax
@@ -103,6 +107,7 @@ class FusedTrainStep(Unit):
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
                  shard_update: bool = False,
+                 shard_params: bool = False,
                  clip_norm: Optional[float] = None,
                  accumulate_steps: int = 1,
                  ema_decay: Optional[float] = None, **kwargs) -> None:
@@ -127,13 +132,25 @@ class FusedTrainStep(Unit):
         #: Per-minibatch metrics still publish every run; clipping (and
         #: the adam step count) applies per EFFECTIVE batch.
         self.accumulate_steps = int(accumulate_steps)
+        #: ZeRO-grade persistent PARAMETER sharding (ISSUE 15): w/b live
+        #: flat-sharded over ``data`` BETWEEN steps exactly like the
+        #: optimizer state, full weights materialize on demand through a
+        #: per-leaf all-gather chain (zero.gather_chain) for each
+        #: forward/backward, and the post-update regather disappears —
+        #: each replica keeps only its updated slice.  Per-chip
+        #: persistent state (params + momenta + adam moments + EMA)
+        #: scales 1/n with the dp mesh; numerics stay bit-identical to
+        #: the replicated update (the gather is exact data movement and
+        #: the shard update is elementwise on the same values).  Implies
+        #: ``shard_update``.
+        self.shard_params = bool(shard_params)
         #: ZeRO-style cross-replica sharding of the weight update (Xu et
         #: al. 2020, arXiv:2004.13336): gradients reduce-scatter over the
         #: ``data`` axis, each replica updates only its 1/n shard of the
         #: params with its 1/n shard of the OPTIMIZER STATE (momenta live
         #: sharded — the memory win), and updated params all-gather back.
         #: Numerically equivalent to the replicated update.
-        self.shard_update = bool(shard_update)
+        self.shard_update = bool(shard_update) or self.shard_params
         #: global-norm gradient clipping (None = off): the batch-mean
         #: gradient across ALL layers is rescaled to at most this L2
         #: norm before the optimizer applies it (standard global clip)
@@ -201,6 +218,9 @@ class FusedTrainStep(Unit):
         self._bs_acc = None       # device-side summed sample count
         self._acc_count = 0       # minibatches since last apply
         self._hyper_cache = None  # (signature, device pytree)
+        self._zero_gather_nbytes = 0   # bytes gathered per dispatch
+        self._zero_gather_counter = None   # cached registry child
+        self._gather_via_psum = False  # resolved from config at build
         self._acc = None          # device-side metric sums (deferred mode)
         self._conf_seen = None    # confusion sums already folded this pass
         self._nt_valid = None     # nearest-target recovery proven valid?
@@ -239,13 +259,25 @@ class FusedTrainStep(Unit):
         flat = np.pad(flat, (0, (-len(flat)) % n))
         return self._put(flat, P("data"))
 
+    def _leaf_sharded(self, k: str) -> bool:
+        """Does leaf key ``k`` live flat-sharded over ``data``?  THE one
+        layout decision shared by gather_params/param_specs/
+        extra_state_arrays/load_extra_state/sync_to_units."""
+        if k in self.OPT_STATE_KEYS:
+            return self.shard_update
+        if k in ("w", "b", "ew", "eb"):
+            return self.shard_params
+        return False            # t (scalar step count)
+
     def gather_params(self):
         """Build the params pytree from the unit Arrays: w/b replicated
         over the mesh (the sharding the step outputs, so the jit
         signature is stable from the first call); optimizer-state leaves
-        flat-sharded over ``data`` when ``shard_update``."""
+        flat-sharded over ``data`` when ``shard_update``; w/b (and the
+        EMA mirrors) flat-sharded too when ``shard_params``."""
         put = lambda a: self._put(np.asarray(a))  # noqa: E731
         put_v = self._flat_shard_put if self.shard_update else put
+        put_w = self._flat_shard_put if self.shard_params else put
 
         def put_state(a):
             # momentum buffers live in state_dtype (unit Arrays / snapshots
@@ -256,7 +288,7 @@ class FusedTrainStep(Unit):
 
         params = []
         for fwd, gd in zip(self.forwards, self.gds):
-            leaf = {k: put(arr.map_read())
+            leaf = {k: put_w(arr.map_read())
                     for k, arr in fwd.param_arrays().items()}
             if "w" in leaf:
                 leaf["vw"] = put_state(
@@ -279,41 +311,47 @@ class FusedTrainStep(Unit):
                     leaf["sb"] = put_v(np.zeros_like(fwd.bias.map_read()))
                 leaf["t"] = put(np.float32(0.0))
             if self.ema_decay is not None:
-                # EMA mirrors are replicated like the params they track
+                # EMA mirrors share the layout of the params they track
+                # (flat-sharded under shard_params)
                 if "w" in leaf:
-                    leaf["ew"] = put(fwd.weights.map_read())
+                    leaf["ew"] = put_w(fwd.weights.map_read())
                 if "b" in leaf:
-                    leaf["eb"] = put(fwd.bias.map_read())
+                    leaf["eb"] = put_w(fwd.bias.map_read())
             params.append(leaf)
         return params
 
     def ema_params(self):
         """Host copies of the Polyak-averaged weights: a list of
-        {"w": ..., "b": ...} dicts in unit order (export/eval view)."""
+        {"w": ..., "b": ...} dicts in unit order (export/eval view),
+        fetched in ONE batched ``jax.device_get`` and reassembled from
+        flat shards when ``shard_params``."""
         if self.ema_decay is None:
             raise RuntimeError("ema_decay is not enabled on this step")
-        out = []
-        for leaf in self._params:
-            d = {}
-            if "ew" in leaf:
-                d["w"] = np.asarray(jax.device_get(leaf["ew"]))
-            if "eb" in leaf:
-                d["b"] = np.asarray(jax.device_get(leaf["eb"]))
-            out.append(d)
+        dev = {f"{i}.{k}": leaf[k]
+               for i, leaf in enumerate(self._params)
+               for k in ("ew", "eb") if k in leaf}
+        host = jax.device_get(dev) if dev else {}
+        out = [{} for _ in self._params]
+        for key, val in host.items():
+            i, k = key.split(".", 1)
+            i = int(i)
+            if self._leaf_sharded(k):
+                val = self._unshard_host(val, self._param_shape(i, k))
+            out[i]["w" if k == "ew" else "b"] = np.asarray(val)
         return out
 
     def param_specs(self):
         """Per-leaf PartitionSpecs matching gather_params' placement."""
-        vspec = P("data") if self.shard_update else P()
-        return [{k: (vspec if k in self.OPT_STATE_KEYS else P())
+        return [{k: (P("data") if self._leaf_sharded(k) else P())
                  for k in leaf} for leaf in self._params]
 
-    def _unshard_state(self, leaf_val, like_shape):
-        """Sharded flat optimizer-state array -> host array of the
-        original parameter shape."""
-        flat = np.asarray(jax.device_get(leaf_val))
+    def _unshard_host(self, flat_host, like_shape):
+        """Flat zero-padded HOST array (the device_get of a sharded
+        leaf) -> host array of the original parameter shape.  Callers
+        own the D2H transfer — the snapshot path batches the whole tree
+        into one ``jax.device_get`` before reassembling."""
         size = int(np.prod(like_shape))
-        return flat[:size].reshape(like_shape)
+        return np.asarray(flat_host).reshape(-1)[:size].reshape(like_shape)
 
     def hyper_params(self):
         """Per-layer hyperparams as host floats (traced scalars)."""
@@ -341,11 +379,47 @@ class FusedTrainStep(Unit):
         fwd = self.forwards[i]
         return (fwd.weights if key.endswith("w") else fwd.bias).shape
 
+    def _account_zero_memory(self) -> None:
+        """Per-chip persistent-state byte accounting into the
+        ``znicz_zero_*`` registry families: params (w/b) vs
+        optimizer/EMA state, sharded leaves counted at their 1/n slice
+        (padding included — the flat arrays are padded to a multiple of
+        n, so the per-chip figure carries the real padding epsilon).
+        Also fixes the static per-dispatch gathered-bytes figure for the
+        shard_params chain and caches its counter child."""
+        n = self.mesh.shape["data"]
+        param_b = opt_b = gather_b = 0
+        for leaf in self._params:
+            for k, v in leaf.items():
+                nb = int(np.prod(v.shape)) * v.dtype.itemsize
+                per_chip = nb // n if self._leaf_sharded(k) else nb
+                if k in ("w", "b"):
+                    param_b += per_chip
+                    if self.shard_params:
+                        gather_b += nb
+                else:
+                    opt_b += per_chip
+        self._zero_gather_nbytes = gather_b
+        _probe.zero_memory(self.name, param_b, opt_b)
+        self._zero_gather_counter = _probe.zero_gather_counter(self.name)
+
+    def _note_gathered(self, n_steps: int = 1) -> None:
+        """Count ``n_steps`` dispatches' worth of on-demand all-gather
+        traffic (every dispatch under shard_params — train, eval, or
+        each scanned minibatch — regathers the full w/b set once)."""
+        if self._zero_gather_nbytes and _probe.enabled():
+            self._zero_gather_counter.inc(
+                float(self._zero_gather_nbytes) * n_steps)
+
     def extra_state_arrays(self) -> dict:
         """Optimizer state that has no unit Array home (adam second
-        moments + step count) -> host arrays for the snapshotter, always
-        in the PARAM shape (snapshots stay layout-independent: a sharded
-        run restores into a replicated one and vice versa)."""
+        moments + step count, EMA mirrors) -> host arrays for the
+        snapshotter, always in the PARAM shape (snapshots stay
+        layout-independent: a sharded run restores into a replicated one
+        and vice versa).  The whole tree comes down in ONE
+        ``jax.device_get`` call — one blocking transfer per snapshot,
+        not one per optimizer-state leaf (snapshot stalls must not scale
+        with layer count)."""
         out = {}
         if self._params is None:
             return out
@@ -354,37 +428,59 @@ class FusedTrainStep(Unit):
             keys += ["sw", "sb", "t"]
         if self.ema_decay is not None:
             keys += ["ew", "eb"]
-        for i, leaf in enumerate(self._params):
-            for k in keys:
-                if k not in leaf:
-                    continue
-                if k in ("t", "ew", "eb") or not self.shard_update:
-                    # t is scalar; ew/eb are replicated param mirrors
-                    out[f"{i}.{k}"] = np.asarray(jax.device_get(leaf[k]))
-                else:
-                    out[f"{i}.{k}"] = self._unshard_state(
-                        leaf[k], self._param_shape(i, k))
+        dev = {f"{i}.{k}": leaf[k]
+               for i, leaf in enumerate(self._params)
+               for k in keys if k in leaf}
+        host = jax.device_get(dev) if dev else {}
+        for key, val in host.items():
+            i, k = key.split(".", 1)
+            if self._leaf_sharded(k):
+                val = self._unshard_host(val, self._param_shape(int(i), k))
+            out[key] = np.asarray(val)
         return out
 
     def load_extra_state(self, arrays: dict) -> None:
         """Restore extra_state_arrays output into the (already rebuilt)
-        device params — call after gather_params on resume."""
+        device params — call after gather_params on resume.  Arrays
+        arrive in the PARAM shape and land in whatever layout THIS step
+        uses (the cross-layout resume contract)."""
         for key, val in arrays.items():
             i, k = key.split(".", 1)
-            if k not in ("t", "ew", "eb") and self.shard_update:
+            if self._leaf_sharded(k):
                 self._params[int(i)][k] = self._flat_shard_put(val)
             else:
                 self._params[int(i)][k] = self._put(np.asarray(val))
 
     def sync_to_units(self) -> None:
         """Write the device params back into the unit Arrays (snapshot /
-        inspection path; the hot loop never does this)."""
+        inspection path; the hot loop never does this).  Replicated
+        leaves hand their device buffer over zero-copy (set_devmem);
+        flat-sharded leaves come down in ONE batched ``jax.device_get``
+        and reassemble to the param shape host-side."""
+        fetch = {f"{i}.{k}": leaf[k]
+                 for i, leaf in enumerate(self._params)
+                 for k in ("w", "b", "vw", "vb")
+                 if k in leaf and self._leaf_sharded(k)}
+        host = jax.device_get(fetch) if fetch else {}
+
+        def put_host(arr, flat, shape):
+            arr.map_invalidate()
+            arr.mem = np.asarray(self._unshard_host(flat, shape),
+                                 dtype=np.float32)
+
         for i, (fwd, gd, leaf) in enumerate(
                 zip(self.forwards, self.gds, self._params)):
             if "w" in leaf:
-                fwd.weights.set_devmem(leaf["w"])
+                if self.shard_params:
+                    put_host(fwd.weights, host[f"{i}.w"],
+                             fwd.weights.shape)
+                else:
+                    fwd.weights.set_devmem(leaf["w"])
             if "b" in leaf:
-                fwd.bias.set_devmem(leaf["b"])
+                if self.shard_params:
+                    put_host(fwd.bias, host[f"{i}.b"], fwd.bias.shape)
+                else:
+                    fwd.bias.set_devmem(leaf["b"])
             if not self.shard_update:
                 # unit buffers are f32 (astype is a no-op without
                 # state_dtype; exact widening with it)
@@ -396,14 +492,13 @@ class FusedTrainStep(Unit):
                         leaf["vb"].astype(jnp.float32))
                 continue
             # sharded momenta: reassemble to the param shape host-side
+            # (the batched fetch above; f32 widening is exact)
             if "w" in leaf:
-                gd.gradient_weights.map_invalidate()
-                gd.gradient_weights.mem = np.asarray(self._unshard_state(
-                    leaf["vw"], fwd.weights.shape), dtype=np.float32)
+                put_host(gd.gradient_weights, host[f"{i}.vw"],
+                         fwd.weights.shape)
             if "b" in leaf:
-                gd.gradient_bias.map_invalidate()
-                gd.gradient_bias.mem = np.asarray(self._unshard_state(
-                    leaf["vb"], fwd.bias.shape), dtype=np.float32)
+                put_host(gd.gradient_bias, host[f"{i}.vb"],
+                         fwd.bias.shape)
 
     # -- forward / loss composition -----------------------------------------
     def _forward_chain(self, params, x, train: bool, rng=None):
@@ -580,10 +675,9 @@ class FusedTrainStep(Unit):
         # Pallas kernel casts in-tile (single HBM pass preserved)
 
         if self.shard_update:
-            from znicz_tpu.parallel import zero
-
             n_data = self.mesh.shape["data"]   # static: pad math below
             rank = jax.lax.axis_index("data")
+            sp = self.shard_params
 
             def my_slice(w):
                 return zero.pad_slice(w, rank, n_data)
@@ -600,7 +694,8 @@ class FusedTrainStep(Unit):
                 # shard — the sharding win is the ZeRO-1 one (optimizer
                 # state + update compute at 1/n), not grad bandwidth
                 g = my_slice(grad[wk])
-                w_sh = my_slice(leaf[wk])
+                # under shard_params the leaf already IS the flat shard
+                w_sh = leaf[wk] if sp else my_slice(leaf[wk])
                 if self.optimizer == "adam":
                     w_sh, new[vk], new[sk] = adam_upd(
                         w_sh, g, leaf[vk], leaf[sk], t_new, h[lr_k],
@@ -609,7 +704,10 @@ class FusedTrainStep(Unit):
                     mom_k = "mom" if wk == "w" else "mom_b"
                     w_sh, new[vk] = upd(w_sh, g, leaf[vk], h[lr_k],
                                         h[wd_k], h["l1"], h[mom_k], bs)
-                new[wk] = regather(w_sh, leaf[wk])
+                # shard_params: the updated slice IS the persistent
+                # layout — the post-update regather disappears entirely
+                # (the next forward regathers on demand instead)
+                new[wk] = w_sh if sp else regather(w_sh, leaf[wk])
         else:
             apply = None
 
@@ -654,6 +752,32 @@ class FusedTrainStep(Unit):
             new_params.append(new)
         return new_params
 
+    def _gather_full(self, leaves):
+        """``shard_params`` materialization: full w/b arrays from the
+        flat shards via the per-leaf all-gather chain
+        (:func:`zero.gather_chain`), dispatched in consumption order
+        ahead of the forward so XLA's async collectives overlap leaf
+        i+1's gather with leaf i's compute.  Non-w/b keys pass through;
+        a no-op without ``shard_params``."""
+        if not self.shard_params:
+            return leaves
+        n = self.mesh.shape["data"]
+        rank = jax.lax.axis_index("data")
+        shards, likes, sites = [], [], []
+        for i, leaf in enumerate(leaves):
+            for k in ("w", "b"):
+                if k in leaf:
+                    shards.append(leaf[k])
+                    likes.append(jax.ShapeDtypeStruct(
+                        self._param_shape(i, k), leaf[k].dtype))
+                    sites.append((i, k))
+        full = zero.gather_chain(shards, likes, rank, n, "data",
+                                 via_psum=self._gather_via_psum)
+        out = [dict(leaf) for leaf in leaves]
+        for (i, k), v in zip(sites, full):
+            out[i][k] = v
+        return out
+
     def _local_grads(self, params, key, x, labels, mask):
         """Gradient-accumulation half-step: summed grads + metrics, NO
         update (the apply happens every ``accumulate_steps`` runs)."""
@@ -661,6 +785,13 @@ class FusedTrainStep(Unit):
         rng = jax.random.fold_in(sub, jax.lax.axis_index("data"))
         trainable = [{k: v for k, v in leaf.items() if k in ("w", "b")}
                      for leaf in params]
+        # shard_params: materialize full weights OUTSIDE the
+        # differentiated function — grads land param-shaped and reduce
+        # through the SAME explicit psum as every other mode (AD through
+        # the gather would transpose to a reduce-scatter, changing the
+        # reduction path and with it the bit-exact parity with the
+        # replicated/shard_update paths); the update slices them
+        trainable = self._gather_full(trainable)
 
         def loss_fn(ps):
             out, logits_tail = self._forward_chain(ps, x, train=True,
@@ -689,6 +820,7 @@ class FusedTrainStep(Unit):
         return self._apply_update(params, grads, hyper, bs)
 
     def _local_eval(self, params, x, labels, mask):
+        params = self._gather_full(params)
         out, logits_tail = self._forward_chain(params, x, train=False)
         _, metrics = self._loss_and_metrics(out, logits_tail, labels, mask)
         metrics = jax.lax.psum(metrics, "data")
@@ -743,7 +875,15 @@ class FusedTrainStep(Unit):
         if self.compute_dtype is None:
             self.compute_dtype = getattr(device, "compute_dtype", None) or \
                 jnp.float32
+        # shard_params regather flavor: payload-proportional all_gather
+        # by default; engine.zero_gather_via_psum opts into the
+        # provably-replicating psum fallback (parallel/compat.py shim
+        # notes — the checker cannot infer replication through the
+        # all_gather, so a caller re-enabling check_vma needs this)
+        self._gather_via_psum = bool(root.common.engine.get(
+            "zero_gather_via_psum", False))
         self._params = self.gather_params()
+        self._account_zero_memory()
         self._key = self._put(prng.get().key())
         rep, sh = P(), P("data")
         pspecs = self.param_specs()
@@ -798,7 +938,6 @@ class FusedTrainStep(Unit):
         # separate watches; the probe holds weakrefs, so a dropped step
         # reaps its own entry) while the metric label stays the class
         # name.
-        from znicz_tpu.observe import probe as _probe
         label = type(self).__name__
         for attr in ("_train_fn", "_eval_fn", "_grad_fn", "_apply_fn",
                      "_train_fn_idx", "_eval_fn_idx", "_grad_fn_idx",
@@ -924,7 +1063,6 @@ class FusedTrainStep(Unit):
                        in_specs=(pspecs, rep, rep, sh, sh, sh),
                        out_specs=(pspecs, rep, rep))
         donate = (0, 1) if self.donate else ()
-        from znicz_tpu.observe import probe as _probe
         self._scan_fn = _probe.time_compiles(
             type(self).__name__, jax.jit(fn, donate_argnums=donate))
 
@@ -943,6 +1081,7 @@ class FusedTrainStep(Unit):
             self._build_scan_fn()
         self._params, self._key, metrics = self._scan_fn(
             self._params, self._key, self._hyper_device(), xs, ys, masks)
+        self._note_gathered(int(xs.shape[0]))
         return metrics
 
     # -- input-pipeline staging ---------------------------------------------
@@ -1087,6 +1226,7 @@ class FusedTrainStep(Unit):
             else:
                 metrics = self._scan_idx_fns["eval"](
                     self._params, data, labels, idxs, ms)
+            self._note_gathered(int(idxs.shape[0]))
             self._acc = metrics
             self._scan_in_flight = True
         if loader.last_minibatch:
@@ -1101,6 +1241,9 @@ class FusedTrainStep(Unit):
             self.minibatch_size = 0
 
     def _finish_run(self, loader, metrics) -> None:
+        # one dispatch (train, grads half-step, or eval) = one on-demand
+        # full-weight regather under shard_params
+        self._note_gathered()
         # chaos hook (site "step.params"): NaN-poisons the param pytree —
         # the observable effect of NaN gradients — so health-guard and
         # rollback paths are exercised against the real fused step

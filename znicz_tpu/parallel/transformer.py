@@ -32,7 +32,7 @@ from znicz_tpu.parallel.moe import (load_balance_aux, moe_ffn,
 from znicz_tpu.parallel.pipeline import pipeline_apply
 from znicz_tpu.parallel.ring_attention import (ring_attention,
                                                ring_flash_attention)
-from znicz_tpu.parallel import tp
+from znicz_tpu.parallel import tp, zero
 
 
 def _layer_norm(x, g, b, eps=1e-5):
@@ -160,6 +160,84 @@ def param_specs(n_layers: int, head_sharded: bool = False,
         })
     head = P(None, "model") if head_sharded else P()
     return {"emb": P(), "head": head, "blocks": [dict(blk)] * n_layers}
+
+
+def param_shapes(n_layers: int, d: int, ff: int, vocab: int,
+                 n_experts: int | None = None):
+    """Shape pytree mirroring :func:`init_params` — the static ``like``
+    information the shard_params gather chain needs (a flat-sharded
+    leaf has lost its original shape)."""
+    blk = {
+        "ln1_g": (d,), "ln1_b": (d,),
+        "wq": (d, d), "wk": (d, d), "wv": (d, d), "wo": (d, d),
+        "ln2_g": (d,), "ln2_b": (d,),
+    }
+    if n_experts:
+        blk.update({
+            "gate": (d, n_experts),
+            "ew1": (n_experts, d, ff), "eb1": (n_experts, ff),
+            "ew2": (n_experts, ff, d), "eb2": (n_experts, d),
+        })
+    else:
+        blk.update({"w1": (d, ff), "b1": (ff,),
+                    "w2": (ff, d), "b2": (d,)})
+    return {"emb": (vocab, d), "head": (d, vocab),
+            "blocks": [dict(blk)] * n_layers}
+
+
+def _spec_leaves(specs):
+    # PartitionSpec is a tuple subclass (a pytree container), so spec
+    # trees flatten with an is_leaf guard (same trick as local_step)
+    return jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def _shape_leaves(shapes):
+    return jax.tree.leaves(shapes,
+                           is_leaf=lambda x: isinstance(x, tuple))
+
+
+def shard_params_specs(specs):
+    """Layout of a ``shard_params`` step's params: every REPLICATED
+    (``P()``) leaf becomes a flat array sharded ``P("data")``;
+    tensor-sharded leaves keep their specs (they already live
+    partitioned)."""
+    return jax.tree.map(lambda s: P("data") if s == P() else s, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params_host(params, specs, n: int):
+    """Host-side conversion INTO the shard_params layout: replicated
+    leaves flatten and zero-pad to a multiple of ``n`` (place them with
+    :func:`shard_params_specs`); tensor-sharded leaves pass through.
+    ``specs`` is the REPLICATED-layout tree (:func:`param_specs`)."""
+    flat_w, treedef = jax.tree.flatten(params)
+    out = []
+    for w, s in zip(flat_w, _spec_leaves(specs)):
+        if s == P():
+            f = np.asarray(w).reshape(-1)
+            pad = (-f.size) % n
+            if pad:
+                f = np.pad(f, (0, pad))
+            out.append(f)
+        else:
+            out.append(w)
+    return jax.tree.unflatten(treedef, out)
+
+
+def unshard_params_host(params, specs, shapes):
+    """Inverse of :func:`shard_params_host` on host arrays (the caller
+    ``jax.device_get``s first): flat-padded leaves slice back to their
+    original shapes from the :func:`param_shapes` tree."""
+    flat_w, treedef = jax.tree.flatten(params)
+    out = []
+    for w, s, shp in zip(flat_w, _spec_leaves(specs),
+                         _shape_leaves(shapes)):
+        if s == P():
+            size = int(np.prod(shp))
+            out.append(np.asarray(w).reshape(-1)[:size].reshape(shp))
+        else:
+            out.append(np.asarray(w))
+    return jax.tree.unflatten(treedef, out)
 
 
 def _block(x, p, heads_local: int, causal: bool, use_flash: bool = False,
@@ -418,6 +496,7 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
 def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     vocab: int, lr: float = 0.1, causal: bool = True,
                     compute_dtype=None, shard_update: bool = False,
+                    shard_params: bool = False,
                     masked: bool = False, donate: bool = False,
                     remat: bool = False, loss_chunks: int | None = None,
                     head_sharded: bool = False,
@@ -481,7 +560,23 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     fused step's stateful shard_update (parallel/step.py, where the
     ZeRO-1 memory win is real) must match.  Tensor-sharded leaves
     already live partitioned and update locally.
+
+    ``shard_params`` (ISSUE 15) goes further: the replicated leaves
+    PERSIST flat-sharded over ``data`` between steps — per-chip
+    parameter memory for those leaves is 1/n — and full weights
+    materialize on demand through the per-leaf all-gather chain
+    (zero.gather_chain) ahead of each forward; the update applies on
+    the local slice and the post-update regather disappears.  Params
+    must arrive in the :func:`shard_params_host` layout and the
+    returned specs are :func:`shard_params_specs`; read results back
+    with :func:`unshard_params_host`.  Subsumes (and refuses to compose
+    with) ``shard_update``.
     """
+    if shard_params and shard_update:
+        raise ValueError(
+            "shard_params subsumes shard_update (replicated leaves "
+            "persist sharded and update in place — there is no "
+            "regather left to split); pass only one")
     heads_local = _check_tp(mesh, heads, d, ff,
                             vocab if head_sharded else None, n_experts)
     if remat_policy is not None and remat_policy not in _REMAT_POLICIES:
@@ -505,18 +600,43 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
             "engine.flash_attention=False (dense ring) in interpret "
             "mode, or run compiled on TPU.")
     n_data = mesh.shape["data"]
+    shapes = param_shapes(n_layers, d, ff, vocab, n_experts=n_experts)
+    step_specs = shard_params_specs(specs) if shard_params else specs
+    via_psum = bool(root_cfg.common.engine.get("zero_gather_via_psum",
+                                               False))
 
     def _sharded_sgd(w, g, scale):
         """w - lr*g/scale computed on this replica's 1/n slice only,
         reassembled via a (provably replicating) psum."""
-        from znicz_tpu.parallel import zero
-
         rank = lax.axis_index("data")
         new_sh = zero.pad_slice(w, rank, n_data) - \
             lr * zero.pad_slice(g, rank, n_data) / scale
         return zero.psum_regather(new_sh, rank, n_data, "data", w)
 
     def local_step(params, tokens, labels, mask=None):
+        if shard_params:
+            # materialize full replicated leaves from the flat shards —
+            # the on-demand regather chain, OUTSIDE the differentiated
+            # function so grads reduce through the same AD-inserted
+            # psum as the replicated path (bit-parity; AD through the
+            # gather would transpose to a reduce-scatter instead)
+            rank = lax.axis_index("data")
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_s = _spec_leaves(specs)
+            flat_shapes = _shape_leaves(shapes)
+            idx = [i for i, s in enumerate(flat_s) if s == P()]
+            gathered = zero.gather_chain(
+                [flat_p[i] for i in idx],
+                [jax.ShapeDtypeStruct(flat_shapes[i], flat_p[i].dtype)
+                 for i in idx],
+                rank, n_data, "data", via_psum=via_psum)
+            flat_full = list(flat_p)
+            for i, g in zip(idx, gathered):
+                flat_full[i] = g
+            full_params = jax.tree.unflatten(treedef, flat_full)
+        else:
+            full_params = params
+
         def loss_fn(ps):
             return _forward_ce(ps, tokens, labels, mask, heads_local,
                                causal, use_flash, interp, cdt,
@@ -528,15 +648,26 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                                remat_policy=remat_policy,
                                moe_zloss_weight=moe_zloss_weight)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = jax.value_and_grad(loss_fn)(full_params)
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
-        if shard_update:
+        if shard_params:
+            # each replica updates ONLY its slice (grad sliced to match)
+            # and keeps it — no regather; tensor-sharded leaves update
+            # locally as before
+            flat_g = jax.tree.leaves(grads)
+            new_leaves = [
+                flat_p[i] -
+                lr * zero.pad_slice(flat_g[i], rank, n_data) / n_shards
+                if flat_s[i] == P()
+                else flat_full[i] - lr * flat_g[i] / n_shards
+                for i in range(len(flat_p))]
+            new_params = jax.tree.unflatten(treedef, new_leaves)
+        elif shard_update:
             # PartitionSpec is a tuple subclass (a pytree container), so
             # align specs to params by flattening with an is_leaf guard
             flat_w, treedef = jax.tree.flatten(params)
             flat_g = jax.tree.leaves(grads)
-            flat_s = jax.tree.leaves(
-                specs, is_leaf=lambda x: isinstance(x, P))
+            flat_s = _spec_leaves(specs)
             new_leaves = [
                 _sharded_sgd(w, g, n_shards) if s == P()
                 else w - lr * g / n_shards
@@ -549,15 +680,16 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
 
     # replication checking is disabled wholesale by the compat shim
     # (parallel/compat.py) — it false-positives on these psum-composed
-    # updates; _flash_eligible still only allows interpret-flash on a
-    # SINGLETON mesh, where the relaxed psum transposition is exact.
+    # updates (and cannot infer replication through the shard_params
+    # all_gather); _flash_eligible still only allows interpret-flash on
+    # a SINGLETON mesh, where the relaxed psum transposition is exact.
     batch_spec = P("data", "seq")
-    in_specs = (specs, batch_spec, batch_spec) + \
+    in_specs = (step_specs, batch_spec, batch_spec) + \
         ((P("data"),) if masked else ())
     step = shard_map(
         local_step, mesh=mesh, in_specs=in_specs,
-        out_specs=(specs, P()))
-    return jax.jit(step, donate_argnums=(0,) if donate else ()), specs
+        out_specs=(step_specs, P()))
+    return jax.jit(step, donate_argnums=(0,) if donate else ()), step_specs
 
 
 def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,

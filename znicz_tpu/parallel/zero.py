@@ -1,11 +1,22 @@
-"""ZeRO-style update-sharding primitives (Xu et al. 2020,
-arXiv:2004.13336) — THE one copy of the pad/slice/psum-reassembly logic
-shared by the fused workflow step (parallel/step.py) and the sharded
-transformer step (parallel/transformer.py).
+"""ZeRO-style sharding primitives (Xu et al. 2020, arXiv:2004.13336) —
+THE one copy of the pad/slice/regather logic shared by the fused
+workflow step (parallel/step.py) and the sharded transformer step
+(parallel/transformer.py).
 
-``psum_regather`` reassembles disjoint per-replica slices through a psum
-rather than an all_gather because psum PROVABLY yields a replicated
-value under shard_map's vma type system, so P() out_specs type-check.
+Two regather flavors exist because of the shard_map vma type system:
+
+- ``psum_regather`` reassembles disjoint per-replica slices through a
+  psum over a zero buffer.  psum PROVABLY yields a replicated value
+  under the replication checker, so P() out_specs type-check — but it
+  moves (and adds) n× the bytes of the payload.
+- ``all_gather_slices`` concatenates the aligned disjoint slices with
+  ONE ``lax.all_gather(tiled=True)`` — the bytes-on-wire-proportional
+  path used by the persistent-parameter mode (``shard_params``), where
+  full weights materialize on demand per leaf.  The replication checker
+  cannot infer replication through it on the container's jax versions;
+  the compat shim (parallel/compat.py) runs with the checker disabled,
+  and ``via_psum=True`` keeps the provably-replicating fallback one
+  keyword away for callers that re-enable it.
 """
 
 from __future__ import annotations
@@ -16,9 +27,13 @@ import jax.numpy as jnp
 
 def pad_slice(x, rank, n: int):
     """This replica's 1/n slice of ``x`` flattened and zero-padded to a
-    multiple of ``n``.  ``rank`` may be traced (lax.axis_index)."""
+    multiple of ``n``.  ``rank`` may be traced (lax.axis_index).  The
+    pad is skipped entirely when ``x.size`` already divides by ``n`` —
+    the common aligned case must not pay a copy for a no-op."""
     flat = x.reshape(-1)
-    flat = jnp.pad(flat, (0, (-flat.shape[0]) % n))
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
     shard = flat.shape[0] // n
     return jax.lax.dynamic_slice(flat, (rank * shard,), (shard,))
 
@@ -26,9 +41,43 @@ def pad_slice(x, rank, n: int):
 def psum_regather(shard, rank, n: int, axis_name: str, like):
     """Disjoint per-replica slices -> the full array of ``like``'s shape,
     replicated (each replica writes its slice into a zero buffer at its
-    offset; the psum sums the disjoint contributions)."""
+    offset; the psum sums the disjoint contributions).  ``like`` only
+    needs ``.size``/``.shape`` (an array or a ShapeDtypeStruct)."""
     size = shard.shape[0]
     buf = jnp.zeros((size * n,), shard.dtype)
     buf = jax.lax.dynamic_update_slice(buf, shard, (rank * size,))
     full = jax.lax.psum(buf, axis_name)
     return full[:like.size].reshape(like.shape)
+
+
+def all_gather_slices(shard, rank, n: int, axis_name: str, like,
+                      via_psum: bool = False):
+    """Disjoint per-replica flat slices -> the full array of ``like``'s
+    shape, replicated, via one concatenating ``lax.all_gather`` —
+    payload-proportional bytes on the wire, no zero buffer and no adds.
+    Slices must be the aligned ``pad_slice`` layout (rank-ordered, equal
+    length, zero-padded tail).  ``via_psum=True`` routes through
+    :func:`psum_regather` instead — the vma-safe fallback for callers
+    running with the replication checker enabled (parallel/compat.py
+    disables it by default, which is what lets the all_gather path
+    type-check)."""
+    if via_psum:
+        return psum_regather(shard, rank, n, axis_name, like)
+    full = jax.lax.all_gather(shard, axis_name, tiled=True)
+    return full[:like.size].reshape(like.shape)
+
+
+def gather_chain(shards, likes, rank, n: int, axis_name: str,
+                 via_psum: bool = False):
+    """Materialize a list of full arrays from their per-replica slices —
+    the ``shard_params`` on-demand regather chain.  Each leaf gets its
+    OWN collective, dispatched in consumption order ahead of the forward
+    that consumes it: the gathers carry no data dependency on the
+    downstream compute, so XLA's async-collective scheduling overlaps
+    leaf i+1's gather with leaf i's compute (the ring_attention
+    overlap effect — K/V blocks in flight while the current block's
+    scores compute — applied to the parameter gather chain; one fused
+    whole-tree gather would serialize instead)."""
+    return [all_gather_slices(s, rank, n, axis_name, like,
+                              via_psum=via_psum)
+            for s, like in zip(shards, likes)]

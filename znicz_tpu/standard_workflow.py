@@ -130,6 +130,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  optimizer: str = "sgd",
                  optimizer_config: Optional[dict] = None,
                  shard_update: bool = False,
+                 shard_params: bool = False,
                  clip_norm: Optional[float] = None,
                  accumulate_steps: int = 1,
                  ema_decay: Optional[float] = None,
@@ -160,6 +161,10 @@ class StandardWorkflow(StandardWorkflowBase):
         self.optimizer_config = optimizer_config
         #: ZeRO-style sharded weight update over the data axis
         self.shard_update = shard_update
+        #: ZeRO-grade persistent parameter sharding: params live
+        #: flat-sharded between steps, full weights all-gather on demand
+        #: (implies shard_update; docs/TUNING.md "ZeRO modes")
+        self.shard_params = shard_params
         #: global-norm gradient clipping (fused step)
         self.clip_norm = clip_norm
         #: gradient accumulation: optimizer applies every N minibatches
@@ -171,6 +176,9 @@ class StandardWorkflow(StandardWorkflowBase):
                              f"(the eager gd units implement SGD only)")
         if shard_update and not fused:
             raise ValueError("shard_update requires fused=True (the eager "
+                             "gd units keep fully replicated state)")
+        if shard_params and not fused:
+            raise ValueError("shard_params requires fused=True (the eager "
                              "gd units keep fully replicated state)")
         if clip_norm is not None and not fused:
             raise ValueError("clip_norm requires fused=True (the eager gd "
@@ -308,7 +316,8 @@ class StandardWorkflow(StandardWorkflowBase):
             gds=self.gds, loader=self.loader, mesh=self.mesh,
             defer_metrics=self.defer_metrics, optimizer=self.optimizer,
             optimizer_config=self.optimizer_config,
-            shard_update=self.shard_update, clip_norm=self.clip_norm,
+            shard_update=self.shard_update,
+            shard_params=self.shard_params, clip_norm=self.clip_norm,
             accumulate_steps=self.accumulate_steps,
             ema_decay=self.ema_decay, name="FusedStep")
         # re-route control: loader -> step -> decision

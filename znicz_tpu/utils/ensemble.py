@@ -81,10 +81,19 @@ class Ensemble(Logger):
     def _member_outputs(self, w, data: np.ndarray) -> np.ndarray:
         """Forward ``data`` through a trained member's fused params."""
         step = w.step
-        params = step._params
-        out, _ = step._forward_chain(
-            [{k: v for k, v in leaf.items()} for leaf in params],
-            jnp.asarray(data), train=False)
+        if getattr(step, "shard_params", False):
+            # flat-sharded layout: this committee forward runs OUTSIDE
+            # shard_map (no axis to all-gather over), so rebuild full
+            # w/b from the unit Arrays — train()'s stop() already
+            # synced the final device slices back into them
+            params = [{k: jnp.asarray(arr.map_read())
+                       for k, arr in fwd.param_arrays().items()}
+                      for fwd in step.forwards]
+        else:
+            params = [{k: v for k, v in leaf.items()}
+                      for leaf in step._params]
+        out, _ = step._forward_chain(params, jnp.asarray(data),
+                                     train=False)
         return np.asarray(out)
 
     def predict_classes(self, data: np.ndarray) -> np.ndarray:
