@@ -1350,6 +1350,99 @@ def bench_zero_sharding(epochs=3, minibatch=32, n_train=640, n_valid=0,
     assert not violations, "; ".join(violations)
 
 
+def bench_quantized_collectives(epochs=3, minibatch=32, n_train=640,
+                                n_valid=0, hidden=128):
+    """Quantized-collectives scenario (ISSUE 18), CPU by design on the
+    same forced 8-virtual-device platform as bench_zero_sharding (it
+    measures the codec + accounting machinery, not the chip): the SAME
+    seeded adam shard_params workflow runs exact vs int8+error-feedback
+    across dp mesh sizes, recording bytes-on-wire vs step time and the
+    seeded loss trajectory.  ``znicz_zero_gathered_bytes_total`` is
+    recorded before (exact) and after (quantized) so the artifact holds
+    the regather traffic the codec compressed.  The line lands first;
+    the wire contract (int8 <= 0.27x exact on BOTH collectives) and the
+    trajectory band are ASSERTED after it flushes."""
+    import time as _time
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.backends import TPUDevice
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.observe import registry
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    def counter(name, **labels):
+        return registry.REGISTRY.get(name).labels(
+            unit="FusedStep", **labels).get()
+
+    def run_once(n_dev, qc):
+        # the counters are process-cumulative: snapshot before the run
+        # so each cell reports ITS traffic, not the session total
+        gathered0 = counter("znicz_zero_gathered_bytes_total")
+        qcomm0 = {coll: (counter("znicz_qcomm_bytes_on_wire_total",
+                                 collective=coll),
+                         counter("znicz_qcomm_bytes_exact_total",
+                                 collective=coll))
+                  for coll in ("grad_psum", "zero_gather")}
+        prng.seed_all(31)
+        w = build_fused(max_epochs=epochs, layers=(hidden,),
+                        minibatch_size=minibatch, n_train=n_train,
+                        n_valid=n_valid, mesh=data_parallel_mesh(n_dev),
+                        optimizer="adam", shard_params=True,
+                        quantized_collectives=qc)
+        w.initialize(device=TPUDevice())
+        t0 = _time.perf_counter()
+        w.run()
+        dt = _time.perf_counter() - t0
+        hist = [h["metric_train"] for h in w.decision.metrics_history]
+        gathered = int(counter("znicz_zero_gathered_bytes_total") -
+                       gathered0)
+        qcomm = {coll: (int(counter("znicz_qcomm_bytes_on_wire_total",
+                                    collective=coll) - qcomm0[coll][0]),
+                        int(counter("znicz_qcomm_bytes_exact_total",
+                                    collective=coll) - qcomm0[coll][1]))
+                 for coll in ("grad_psum", "zero_gather")}
+        w.stop()
+        sps = (n_train + n_valid) * epochs / dt
+        return sps, hist, gathered, qcomm
+
+    matrix, violations = {}, []
+    headline_sps = 0.0
+    for n_dev in (2, 4, 8):
+        ex_sps, ex_hist, ex_gathered, _ = run_once(n_dev, None)
+        q_sps, q_hist, q_gathered, qcomm = run_once(
+            n_dev, {"mode": "int8", "error_feedback": True})
+        matrix[f"dp{n_dev}"] = {
+            "exact": {"samples_per_sec": round(ex_sps, 1),
+                      "zero_gathered_bytes": ex_gathered,
+                      "train_err_history": ex_hist},
+            "int8_ef": {"samples_per_sec": round(q_sps, 1),
+                        "zero_gathered_bytes": q_gathered,
+                        "train_err_history": q_hist},
+            "wire_ratio": {coll: round(wire / max(exact, 1), 4)
+                           for coll, (wire, exact) in qcomm.items()},
+        }
+        for coll, (wire, exact) in qcomm.items():
+            if not 0 < wire <= 0.27 * exact:
+                violations.append(f"dp{n_dev}/{coll}: wire {wire}B > "
+                                  f"0.27x exact {exact}B")
+        # the seeded trajectory band: int8+EF may differ from exact by
+        # quantization noise, never by a broken reduction — pin each
+        # epoch's train-error count within 5% of the train set
+        band = 0.05 * n_train
+        for e, (a, b) in enumerate(zip(ex_hist, q_hist)):
+            if abs(a - b) > band:
+                violations.append(f"dp{n_dev}: epoch {e} train err "
+                                  f"{b} vs exact {a} (band {band:.0f})")
+        if n_dev == 8:
+            headline_sps = q_sps
+    _emit("qcomm_int8_dp8_samples_per_sec", headline_sps,
+          cpu=True, mesh_sizes=matrix,
+          wire_ratio_dp8=matrix["dp8"]["wire_ratio"])
+    # AFTER the emit so the measurement always lands: a broken wire
+    # contract or trajectory divergence must fail the scenario loudly
+    assert not violations, "; ".join(violations)
+
+
 def bench_metrics_overhead(epochs=3, minibatch=128, n_train=2560,
                            n_valid=640, hidden=256, pairs=20):
     """ISSUE 5 scenario: the telemetry plane's cost on the REAL
@@ -1714,6 +1807,19 @@ def child_main(mode: str) -> None:
         _enable_compile_cache()
         bench_zero_sharding()
         return
+    if mode == "quantized_collectives":
+        # quantized-collectives scenario: the same forced 8-virtual-
+        # device CPU platform as zero_sharding (dp 2/4/8 int8 codec
+        # matrix; the flag must land before the first jax backend init)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
+        bench_quantized_collectives()
+        return
     if mode == "compile_latency":
         # compile-latency scenario: orchestrates two compile_probe
         # children over a fresh shared cache dir + an AOT boot leg
@@ -1850,7 +1956,7 @@ def main():
     # flagship re-emit so the driver's last-line contract is untouched
     for extra_mode in ("serve", "generate", "fleet",
                        "train_while_serve", "pipeline",
-                       "zero_sharding",
+                       "zero_sharding", "quantized_collectives",
                        "metrics_overhead", "compile_latency"):
         # compile_latency's own legs each budget up to CPU_TIMEOUT (two
         # fresh-process probes + the AOT export leg) — its OUTER timeout

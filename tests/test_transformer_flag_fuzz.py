@@ -103,3 +103,56 @@ def test_model_changing_flags_mesh_invariant(cpu_devices):
         np.testing.assert_allclose(
             b, a, rtol=2e-4, atol=2e-5,
             err_msg=f"trial {trial}: masked={masked} {flags}")
+
+
+def test_quantized_collectives_gate(cpu_devices):
+    """ISSUE 18 gate over the quantized transformer path: mode=off is
+    BIT-IDENTICAL to a step that never saw the config for random
+    math-preserving flag combos on random meshes; int8 and bf16 (same
+    explicit-psum semantics, different codec noise) track each other
+    tightly; and on model=1 meshes the quantized trajectory matches the
+    single-device FULL-BATCH run — the true-batch-mean pin the exact
+    path's AD-transposed reduction does not satisfy (see
+    make_train_step's reduction-semantics note)."""
+    rng = np.random.default_rng(18)
+    tokens = rng.integers(0, 16, (4, 16)).astype(np.int32)
+    labels = ((tokens + 1) % 16).astype(np.int32)
+    mask = np.array([True, True, True, False])
+
+    for trial in range(3):
+        mesh_axes = MESHES[int(rng.integers(len(MESHES)))]
+        masked = bool(rng.integers(2))
+        flags = {
+            "loss_chunks": [None, 2][int(rng.integers(2))],
+            "head_sharded": bool(rng.integers(2)),
+            "shard_update": bool(rng.integers(2)),
+        }
+        mesh = make_mesh(mesh_axes)
+        base, base_p = _run(mesh, masked, tokens, labels, mask, **flags)
+        off, off_p = _run(mesh, masked, tokens, labels, mask,
+                          quantized_collectives={"mode": "off"}, **flags)
+        assert off == base, (trial, mesh_axes, masked, flags)
+        for a, b in zip(off_p, base_p):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"trial {trial}: {mesh_axes} {flags}")
+
+    # single-device full-batch reference: what a true batch-mean
+    # gradient trajectory must reproduce regardless of the data/seq
+    # split (the transformer codec path carries no EF residual, so the
+    # int8 band is codec noise alone)
+    ref, _ = _run(make_mesh({"data": 1, "seq": 1, "model": 1}),
+                  False, tokens, labels, mask)
+    for mesh_axes in ({"data": 2, "seq": 1, "model": 1},
+                      {"data": 2, "seq": 2, "model": 1}):
+        mesh = make_mesh(mesh_axes)
+        runs = {}
+        for mode in ("bf16", "int8"):
+            runs[mode], _ = _run(
+                mesh, False, tokens, labels, mask,
+                quantized_collectives={"mode": mode, "chunk": 128})
+        np.testing.assert_allclose(runs["int8"], runs["bf16"],
+                                   rtol=0.05, err_msg=str(mesh_axes))
+        np.testing.assert_allclose(runs["bf16"], ref, rtol=5e-3,
+                                   err_msg=str(mesh_axes))
+        np.testing.assert_allclose(runs["int8"], ref, rtol=0.05,
+                                   err_msg=str(mesh_axes))

@@ -295,3 +295,57 @@ def test_pallas_engine_matches_xla_for_random_stacks(case):
             err_msg=f"layer {i} ({stack[i]['type']}) bias")
         checked += 1
     assert checked >= 1
+
+
+@st.composite
+def fused_flag_combos(draw):
+    """A random fused-step flag combination (sharding layout x optimizer
+    x EMA x narrow momenta) for the quantized-collectives gate."""
+    layout = draw(st.sampled_from(["replicated", "shard_update",
+                                   "shard_params"]))
+    optimizer = draw(st.sampled_from(["sgd", "adam"]))
+    ema_decay = draw(st.sampled_from([None, 0.9]))
+    state_dtype = (draw(st.sampled_from([None, "bfloat16"]))
+                   if optimizer == "sgd" else None)   # SGD-only knob
+    seed = draw(st.integers(1, 2 ** 20))
+    return layout, optimizer, ema_decay, state_dtype, seed
+
+
+@given(fused_flag_combos())
+@settings(max_examples=4, deadline=None, derandomize=True)
+def test_quantized_collectives_across_flag_combos(case):
+    """ISSUE 18 gate: the quantized-collective codec composes with the
+    whole fused-step flag surface — for random shard_update/
+    shard_params/optimizer/ema/state_dtype combinations, mode=off stays
+    BIT-IDENTICAL to a build that never passed the config, and
+    int8+error-feedback trains within a pinned validation-error band of
+    the exact run (the fused step's exact path already psums grads
+    explicitly, so the quantized run differs by codec noise only)."""
+    from znicz_tpu.models.mnist_fc import build_fused
+    from znicz_tpu.parallel.mesh import data_parallel_mesh
+
+    layout, optimizer, ema_decay, state_dtype, seed = case
+    flags = {"shard_update": layout == "shard_update",
+             "shard_params": layout == "shard_params"}
+
+    def run(qc):
+        prng.seed_all(seed)
+        w = build_fused(
+            max_epochs=2, layers=(16,), minibatch_size=16,
+            n_train=96, n_valid=32, mesh=data_parallel_mesh(4),
+            optimizer=optimizer,
+            optimizer_config=({"state_dtype": state_dtype}
+                              if state_dtype else None),
+            ema_decay=ema_decay, quantized_collectives=qc, **flags)
+        w.initialize(device=TPUDevice())
+        w.run()
+        return [h["metric_validation"]
+                for h in w.decision.metrics_history]
+
+    exact = run(None)
+    assert run({"mode": "off"}) == exact, case
+    quant = run({"mode": "int8", "chunk": 64, "error_feedback": True})
+    assert len(quant) == len(exact), case
+    band = max(3.0, 0.05 * 32)     # validation-error counts out of 32
+    for e, q in zip(exact, quant):
+        assert abs(e - q) <= band, (case, exact, quant)

@@ -442,3 +442,115 @@ def test_transformer_shard_params_rejects_shard_update(cpu_devices):
         tfm.make_train_step(make_mesh({"data": 2, "seq": 1, "model": 1}),
                             1, 16, 2, 32, 8, shard_update=True,
                             shard_params=True)
+
+
+# -- ISSUE 18: error-feedback residual snapshot/restore ----------------------
+
+QC = {"mode": "int8", "chunk": 64, "error_feedback": True}
+
+
+def test_ef_residual_snapshot_resume_bit_exact(tmp_path, cpu_devices):
+    """ISSUE 18: error-feedback residuals are real state — a quantized
+    int8+EF run interrupted mid-training resumes BIT-IDENTICAL to the
+    uninterrupted run on the same mesh, in both the replicated and
+    shard_params layouts (the per-rank rw/rb slabs snapshot as-is and
+    restore into the same ranks; dropping them instead would fork the
+    trajectory at the first post-resume step)."""
+    for layout in ("replicated", "shard_params"):
+        w_o = _build(4, 8, layout, quantized_collectives=QC)
+        w_o.initialize(device=TPUDevice())
+        w_o.run()
+        want = _weights(w_o)
+        want_hist = [h["metric_train"]
+                     for h in w_o.decision.metrics_history]
+
+        w_a = _build(2, 8, layout, quantized_collectives=QC)
+        w_a.initialize(device=TPUDevice())
+        w_a.run()
+        arrays, meta = collect_state(w_a)
+        # the residual slabs ride the snapshot, one rank row per device
+        assert arrays["step.opt.0.rw"].shape == \
+            (8,) + w_a.forwards[0].weights.shape, layout
+        assert "step.opt.0.rb" in arrays and "step.opt.1.rw" in arrays
+        snap = str(tmp_path / f"ef_{layout}.npz")
+        write_snapshot(snap, arrays, meta)
+
+        w_b = _build(4, 8, layout, quantized_collectives=QC)
+        w_b.initialize(device=TPUDevice())
+        restore_state(w_b, snap)
+        w_b.decision.max_epochs = 4
+        w_b.decision.complete.set(False)
+        w_b.run()
+        for a, b in zip(_weights(w_b), want):
+            np.testing.assert_array_equal(a, b, err_msg=layout)
+        hist = [h["metric_train"]
+                for h in w_b.decision.metrics_history]
+        assert hist[-2:] == want_hist[-2:], layout
+
+
+def test_ef_cross_mode_restore_matrix(tmp_path, cpu_devices):
+    """The quantized <-> exact cells of the restore matrix, with the
+    layout flipping at the same time: a quantized shard_params snapshot
+    restores into an exact replicated build (the residuals have no home
+    there — dropped, the run completes), and an exact replicated
+    snapshot restores into a quantized shard_params build (residuals
+    start at zero and the EF gauge goes live as training continues)."""
+    # quantized shard_params -> exact replicated
+    w_a = _build(2, 8, "shard_params", quantized_collectives=QC)
+    w_a.initialize(device=TPUDevice())
+    w_a.run()
+    arrays, meta = collect_state(w_a)
+    assert "step.opt.0.rw" in arrays
+    snap = str(tmp_path / "qc_to_exact.npz")
+    write_snapshot(snap, arrays, meta)
+    w_b = _build(4, 8, "replicated")
+    w_b.initialize(device=TPUDevice())
+    restore_state(w_b, snap)
+    w_b.decision.max_epochs = 4
+    w_b.decision.complete.set(False)
+    w_b.run()
+    assert all("rw" not in leaf for leaf in w_b.step._params)
+    assert all(np.isfinite(a).all() for a in _weights(w_b))
+
+    # exact replicated -> quantized shard_params
+    w_c = _build(2, 8, "replicated")
+    w_c.initialize(device=TPUDevice())
+    w_c.run()
+    arrays, meta = collect_state(w_c)
+    assert not any(k.endswith(".rw") for k in arrays)
+    snap2 = str(tmp_path / "exact_to_qc.npz")
+    write_snapshot(snap2, arrays, meta)
+    w_d = _build(4, 8, "shard_params", quantized_collectives=QC)
+    w_d.initialize(device=TPUDevice())
+    restore_state(w_d, snap2)
+    w_d.decision.max_epochs = 4
+    w_d.decision.complete.set(False)
+    w_d.run()
+    assert all(np.isfinite(a).all() for a in _weights(w_d))
+    assert _gauge("znicz_qcomm_residual_norm") > 0
+
+
+def test_ef_residual_cross_world_fold(tmp_path, cpu_devices):
+    """Restoring EF residuals at a DIFFERENT world size folds the rank
+    SUM — the only quantity the deferred-error correction depends on —
+    onto rank 0, and training continues finite from there."""
+    w_a = _build(2, 8, "shard_params", quantized_collectives=QC)
+    w_a.initialize(device=TPUDevice())
+    w_a.run()
+    arrays, meta = collect_state(w_a)
+    want_sum = arrays["step.opt.0.rw"].sum(axis=0)
+    assert np.abs(want_sum).max() > 0            # EF actually accrued
+    snap = str(tmp_path / "ef_fold.npz")
+    write_snapshot(snap, arrays, meta)
+
+    w_b = _build(4, 2, "replicated", quantized_collectives=QC)
+    w_b.initialize(device=TPUDevice())
+    restore_state(w_b, snap)
+    got = np.asarray(w_b.step._params[0]["rw"])
+    assert got.shape[0] == 2
+    np.testing.assert_allclose(got[0], want_sum, rtol=1e-6, atol=1e-7)
+    assert np.abs(got[1]).max() == 0.0
+    w_b.decision.max_epochs = 4
+    w_b.decision.complete.set(False)
+    w_b.run()
+    assert all(np.isfinite(a).all() for a in _weights(w_b))

@@ -18,6 +18,11 @@ Derived flat-key suffixes (`_count`, `_sum`, `_bucket`, `_p50`, `_p95`,
 `{label="..."}` filters are normalized away on BOTH sides before
 comparing, so the catalogue documents families, not every derived key.
 
+On top of the docs<->code sync, every REFERENCED family must also be
+DECLARED somewhere (a `counter(`/`gauge(`/`histogram(` call) — an SLO
+rule or smoke assertion naming a counter that no code registers would
+otherwise pass this check while scraping nothing at runtime.
+
 Exit 0 when the catalogue and the code agree; otherwise print one
 `check_metric_catalogue:`-prefixed line per discrepancy and exit 1.
 """
@@ -43,6 +48,11 @@ NON_METRIC_PREFIXES = ("znicz_tpu",)
 #: exact non-metric literals: __main__.py's importlib module name for
 #: user workflow files
 NON_METRIC_NAMES = {"znicz_workflow"}
+
+#: families emitted straight into a merged/flat view without a registry
+#: object — the fleet federator synthesizes these per source, so no
+#: counter()/gauge()/histogram() declaration exists (or should)
+SYNTHETIC_FAMILIES = {"znicz_fleet_worker_up"}
 
 _NAME_RE = re.compile(r"^znicz_[a-z0-9_]+$")
 _DOC_NAME_RE = re.compile(r"`(znicz_[a-z0-9_{}=\",. ]*?)`")
@@ -85,10 +95,13 @@ def _docstring_nodes(tree: ast.AST) -> set:
     return out
 
 
-def collect_code_families() -> dict:
-    """``{family: first 'path:line' seen}`` for every znicz_ metric
-    name used in znicz_tpu/ source."""
+def collect_code_families() -> tuple:
+    """``({family: first 'path:line' seen}, {declared family: 'path:line'})``
+    for every znicz_ metric name used in znicz_tpu/ source.  The first
+    dict covers ALL uses (declarations and references); the second only
+    the names declared by a `counter(`/`gauge(`/`histogram(` call."""
     families: dict = {}
+    declared: dict = {}
     for dirpath, dirnames, filenames in os.walk(PACKAGE):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fname in sorted(filenames):
@@ -98,7 +111,16 @@ def collect_code_families() -> dict:
             with open(path, encoding="utf-8") as f:
                 tree = ast.parse(f.read(), filename=path)
             docstrings = _docstring_nodes(tree)
+            rel = os.path.relpath(path, REPO)
             for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and \
+                        _call_name(node) in _DECL_FUNCS and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    name = normalize(node.args[0].value)
+                    if _NAME_RE.match(name):
+                        declared.setdefault(
+                            name, f"{rel}:{node.args[0].lineno}")
                 if not isinstance(node, ast.Constant) or \
                         not isinstance(node.value, str):
                     continue
@@ -110,9 +132,8 @@ def collect_code_families() -> dict:
                 if any(name == p or name.startswith(p + "_")
                        for p in NON_METRIC_PREFIXES):
                     continue
-                where = f"{os.path.relpath(path, REPO)}:{node.lineno}"
-                families.setdefault(name, where)
-    return families
+                families.setdefault(name, f"{rel}:{node.lineno}")
+    return families, declared
 
 
 def collect_doc_families() -> dict:
@@ -130,7 +151,7 @@ def collect_doc_families() -> dict:
 
 
 def main() -> int:
-    code = collect_code_families()
+    code, declared = collect_code_families()
     docs = collect_doc_families()
     rc = 0
     for name in sorted(set(code) - set(docs)):
@@ -143,9 +164,15 @@ def main() -> int:
               f"(docs/OBSERVABILITY.md:{docs[name]}) is documented but "
               f"no longer used anywhere in znicz_tpu/", file=sys.stderr)
         rc = 1
+    for name in sorted(set(code) - set(declared) - SYNTHETIC_FAMILIES):
+        print(f"check_metric_catalogue: {name} (referenced at "
+              f"{code[name]}) is never declared by a counter()/gauge()/"
+              f"histogram() call in znicz_tpu/ — it would scrape "
+              f"nothing at runtime", file=sys.stderr)
+        rc = 1
     if rc == 0:
         print(f"check_metric_catalogue: ok — {len(code)} metric "
-              f"families, catalogue in sync")
+              f"families ({len(declared)} declared), catalogue in sync")
     return rc
 
 

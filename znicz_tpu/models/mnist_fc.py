@@ -106,6 +106,7 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
                 mesh=None, loader=None, optimizer="sgd",
                 optimizer_config=None, shard_update=False,
                 shard_params=False, accumulate_steps=1, ema_decay=None,
+                quantized_collectives=None,
                 pipeline_depth=None) -> NNWorkflow:
     """TPU-native shape: Repeater -> Loader -> FusedTrainStep -> Decision."""
     w = NNWorkflow(name="MnistFC-fused")
@@ -119,6 +120,7 @@ def build_fused(max_epochs=4, layers=(64,), lr=0.05, moment=0.9,
         optimizer_config=optimizer_config, shard_update=shard_update,
         shard_params=shard_params,
         accumulate_steps=accumulate_steps, ema_decay=ema_decay,
+        quantized_collectives=quantized_collectives,
         name="FusedStep")
     dec = w.decision = DecisionGD(w, max_epochs=max_epochs)
 

@@ -341,6 +341,55 @@ def zero_gather_counter(unit: str):
     return _ZERO_GATHERED.labels(unit=unit)
 
 
+# -- quantized collectives (ISSUE 18) ----------------------------------------
+
+#: ``collective`` label values: "grad_psum" (the explicit gradient
+#: reduction) and "zero_gather" (the shard_params regather chain)
+_QCOMM_WIRE = _reg.counter(
+    "znicz_qcomm_bytes_on_wire_total",
+    "bytes actually shipped by quantized collectives (int8/bf16 payload "
+    "+ per-chunk scales), per unit and collective site",
+    labelnames=("unit", "collective"))
+_QCOMM_EXACT = _reg.counter(
+    "znicz_qcomm_bytes_exact_total",
+    "bytes the SAME collectives would have shipped unquantized (f32) — "
+    "the before to znicz_qcomm_bytes_on_wire_total's after",
+    labelnames=("unit", "collective"))
+_QCOMM_RATIO = _reg.gauge(
+    "znicz_qcomm_compression_ratio",
+    "exact/wire byte ratio of a quantized collective (~4 for int8 with "
+    "the default chunk, 2 for bf16); set once per step build",
+    labelnames=("unit", "collective"))
+_QCOMM_RESIDUAL = _reg.gauge(
+    "znicz_qcomm_residual_norm",
+    "L2 norm of the error-feedback residual tree carried by a fused "
+    "train step (quantization error deferred into the next step)",
+    labelnames=("unit",))
+
+
+def qcomm_ratio(unit: str, collective: str, wire_bytes: int,
+                exact_bytes: int) -> None:
+    """Static per-dispatch compression figure, set once per step build.
+    Recorded even while probes are disabled (the zero_memory precedent:
+    the wire contract must stay assertable through a bench's bare arm,
+    and a build is never on the per-signal hot path)."""
+    _QCOMM_RATIO.labels(unit=unit, collective=collective).set(
+        float(exact_bytes) / max(float(wire_bytes), 1.0))
+
+
+def qcomm_counters(unit: str, collective: str) -> tuple:
+    """Cached ``(wire, exact)`` counter children for one collective site
+    (the step increments both per dispatch, gated on :func:`enabled`)."""
+    return (_QCOMM_WIRE.labels(unit=unit, collective=collective),
+            _QCOMM_EXACT.labels(unit=unit, collective=collective))
+
+
+def qcomm_residual_norm(unit: str, value: float) -> None:
+    """Error-feedback residual L2 norm (published at class-pass ends —
+    the caller owns the device reduction and the :func:`enabled` gate)."""
+    _QCOMM_RESIDUAL.labels(unit=unit).set(float(value))
+
+
 # -- pipeline plane ----------------------------------------------------------
 
 _BYTES_STAGED = _reg.counter(

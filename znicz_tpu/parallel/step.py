@@ -51,10 +51,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from znicz_tpu.parallel.compat import shard_map
+from znicz_tpu.parallel.compat import quantized_psum, shard_map
 # hoisted out of the program-build path (_apply_update used to import it
-# per trace); the module is jax-only, so the import is always safe here
-from znicz_tpu.parallel import zero
+# per trace); the modules are jax-only, so the import is always safe here
+from znicz_tpu.parallel import qcomm, zero
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.config import root
@@ -110,8 +110,19 @@ class FusedTrainStep(Unit):
                  shard_params: bool = False,
                  clip_norm: Optional[float] = None,
                  accumulate_steps: int = 1,
-                 ema_decay: Optional[float] = None, **kwargs) -> None:
+                 ema_decay: Optional[float] = None,
+                 quantized_collectives: Optional[dict] = None,
+                 **kwargs) -> None:
         super().__init__(workflow, **kwargs)
+        #: quantized-collective codec config (ISSUE 18, EQuARX-style):
+        #: ``{"mode": "off|bf16|int8", "chunk": N, "error_feedback":
+        #: bool}`` — the gradient psum and (under shard_params) the
+        #: regather chain ship int8/bf16 payloads; error feedback
+        #: carries the quantization error into the next step's grads in
+        #: persistent rw/rb residual leaves.  ``None`` defers to
+        #: ``root.common.engine.quantized_collectives``; mode=off (or no
+        #: config at all) compiles today's exact programs bit for bit.
+        self.quantized_collectives = quantized_collectives
         if ema_decay is not None and not 0.0 < ema_decay < 1.0:
             raise ValueError(f"ema_decay must be in (0, 1), got "
                              f"{ema_decay}")
@@ -221,6 +232,12 @@ class FusedTrainStep(Unit):
         self._zero_gather_nbytes = 0   # bytes gathered per dispatch
         self._zero_gather_counter = None   # cached registry child
         self._gather_via_psum = False  # resolved from config at build
+        self._codec = None        # resolved qcomm.Codec (None = exact)
+        self._ef = False          # error-feedback residuals active?
+        self._qcomm_grad_bytes = None    # (wire, exact) per train step
+        self._qcomm_gather_bytes = None  # (wire, exact) per dispatch
+        self._qcomm_grad_counters = None
+        self._qcomm_gather_counters = None
         self._acc = None          # device-side metric sums (deferred mode)
         self._conf_seen = None    # confusion sums already folded this pass
         self._nt_valid = None     # nearest-target recovery proven valid?
@@ -267,6 +284,13 @@ class FusedTrainStep(Unit):
             return self.shard_update
         if k in ("w", "b", "ew", "eb"):
             return self.shard_params
+        if k in ("rw", "rb"):
+            # error-feedback residuals: rank-LOCAL (n, *param_shape)
+            # slabs sharded on axis 0 — each replica carries only its
+            # own quantization error (extra_state_arrays/load_extra_
+            # state special-case these: the slab snapshots as-is, not
+            # through the flat reassembly)
+            return True
         return False            # t (scalar step count)
 
     def gather_params(self):
@@ -317,6 +341,17 @@ class FusedTrainStep(Unit):
                     leaf["ew"] = put_w(fwd.weights.map_read())
                 if "b" in leaf:
                     leaf["eb"] = put_w(fwd.bias.map_read())
+            if self._ef:
+                # error-feedback residuals (one param-shaped slab per
+                # replica, zero at build): quantization error of step t
+                # rides into step t+1's gradient — persistent optimizer-
+                # adjacent state, snapshotted via extra_state_arrays
+                n = self.mesh.shape["data"]
+                for k in ("w", "b"):
+                    if k in leaf:
+                        leaf["r" + k] = self._put(
+                            np.zeros((n,) + self._param_shape(
+                                len(params), k), np.float32), P("data"))
             params.append(leaf)
         return params
 
@@ -344,6 +379,15 @@ class FusedTrainStep(Unit):
         """Per-leaf PartitionSpecs matching gather_params' placement."""
         return [{k: (P("data") if self._leaf_sharded(k) else P())
                  for k in leaf} for leaf in self._params]
+
+    def _res_specs(self):
+        """out_specs for ``_local_grads``' residual-update return: the
+        rw/rb slab layout under error feedback, ``None`` (an empty
+        pytree — zero extra outputs) otherwise."""
+        if not self._ef:
+            return None
+        return [{k: P("data") for k in ("rw", "rb") if k in leaf}
+                for leaf in self._params]
 
     def _unshard_host(self, flat_host, like_shape):
         """Flat zero-padded HOST array (the device_get of a sharded
@@ -402,14 +446,82 @@ class FusedTrainStep(Unit):
         self._zero_gather_nbytes = gather_b
         _probe.zero_memory(self.name, param_b, opt_b)
         self._zero_gather_counter = _probe.zero_gather_counter(self.name)
+        self._account_qcomm()
+
+    def _account_qcomm(self) -> None:
+        """Static per-dispatch wire/exact byte figures for the quantized
+        collectives (same build-time convention as
+        ``_zero_gather_nbytes``), plus the compression-ratio gauges and
+        cached counter children.  Exact bytes follow each collective's
+        native accounting: full f32 grads per train step for the psum,
+        the padded-flat f32 leaf (= ``znicz_zero_gathered_bytes_total``'s
+        figure) per dispatch for the shard_params regather."""
+        if self._codec is None:
+            return
+        n = self.mesh.shape["data"]
+        grad_wire = grad_exact = zg_wire = zg_exact = 0
+        for i, leaf in enumerate(self._params):
+            for k in ("w", "b"):
+                if k not in leaf:
+                    continue
+                size = int(np.prod(self._param_shape(i, k)))
+                grad_wire += qcomm.wire_nbytes(self._codec, size)
+                grad_exact += qcomm.exact_nbytes(size)
+                if self.shard_params:
+                    padded = size + (-size) % n
+                    zg_wire += n * qcomm.wire_nbytes(self._codec,
+                                                     padded // n)
+                    zg_exact += qcomm.exact_nbytes(padded)
+        self._qcomm_grad_bytes = (grad_wire, grad_exact)
+        self._qcomm_grad_counters = _probe.qcomm_counters(
+            self.name, "grad_psum")
+        _probe.qcomm_ratio(self.name, "grad_psum", grad_wire, grad_exact)
+        if self.shard_params:
+            self._qcomm_gather_bytes = (zg_wire, zg_exact)
+            self._qcomm_gather_counters = _probe.qcomm_counters(
+                self.name, "zero_gather")
+            _probe.qcomm_ratio(self.name, "zero_gather", zg_wire,
+                               zg_exact)
 
     def _note_gathered(self, n_steps: int = 1) -> None:
         """Count ``n_steps`` dispatches' worth of on-demand all-gather
         traffic (every dispatch under shard_params — train, eval, or
         each scanned minibatch — regathers the full w/b set once)."""
-        if self._zero_gather_nbytes and _probe.enabled():
+        if not _probe.enabled():
+            return
+        if self._zero_gather_nbytes:
             self._zero_gather_counter.inc(
                 float(self._zero_gather_nbytes) * n_steps)
+        if self._qcomm_gather_bytes:
+            wire, exact = self._qcomm_gather_bytes
+            c_wire, c_exact = self._qcomm_gather_counters
+            c_wire.inc(float(wire) * n_steps)
+            c_exact.inc(float(exact) * n_steps)
+
+    def _note_qcomm_grads(self, n_steps: int = 1) -> None:
+        """Count ``n_steps`` TRAIN dispatches' worth of quantized
+        gradient-psum traffic (eval dispatches compute no grads, so the
+        caller — not ``_finish_run`` — gates on the minibatch class)."""
+        if self._qcomm_grad_bytes and _probe.enabled():
+            wire, exact = self._qcomm_grad_bytes
+            c_wire, c_exact = self._qcomm_grad_counters
+            c_wire.inc(float(wire) * n_steps)
+            c_exact.inc(float(exact) * n_steps)
+
+    def _publish_residual_norm(self) -> None:
+        """Global L2 norm of the error-feedback residual tree into the
+        ``znicz_qcomm_residual_norm`` gauge (class-pass cadence — one
+        small device reduction + scalar fetch, never per minibatch)."""
+        if not self._ef or not _probe.enabled():
+            return
+        total = jnp.zeros((), jnp.float32)
+        for leaf in self._params:
+            for k in ("rw", "rb"):
+                if k in leaf:
+                    r = leaf[k].astype(jnp.float32)
+                    total = total + jnp.vdot(r, r)
+        _probe.qcomm_residual_norm(self.name,
+                                   float(jnp.sqrt(total)))
 
     def extra_state_arrays(self) -> dict:
         """Optimizer state that has no unit Array home (adam second
@@ -428,12 +540,22 @@ class FusedTrainStep(Unit):
             keys += ["sw", "sb", "t"]
         if self.ema_decay is not None:
             keys += ["ew", "eb"]
+        if self._ef:
+            keys += ["rw", "rb"]
         dev = {f"{i}.{k}": leaf[k]
                for i, leaf in enumerate(self._params)
                for k in keys if k in leaf}
         host = jax.device_get(dev) if dev else {}
         for key, val in host.items():
             i, k = key.split(".", 1)
+            if k in ("rw", "rb"):
+                # error-feedback residuals are genuinely per-rank state:
+                # the (n, *param_shape) slab snapshots AS-IS (same mesh
+                # resumes bit-exact; load_extra_state folds the rank sum
+                # — the only quantity the EF correction depends on —
+                # when the world size changed)
+                out[key] = np.asarray(val)
+                continue
             if self._leaf_sharded(k):
                 val = self._unshard_host(val, self._param_shape(int(i), k))
             out[key] = np.asarray(val)
@@ -446,7 +568,23 @@ class FusedTrainStep(Unit):
         uses (the cross-layout resume contract)."""
         for key, val in arrays.items():
             i, k = key.split(".", 1)
-            if self._leaf_sharded(k):
+            if k in ("rw", "rb"):
+                if not self._ef:
+                    # quantized -> exact cross-layout restore: the
+                    # residual has no home (and no effect) here — drop
+                    # it rather than corrupt the leaf layout
+                    continue
+                n = self.mesh.shape["data"]
+                val = np.asarray(val, np.float32)
+                if val.shape[0] != n:
+                    # cross-world restore: only the rank SUM of the
+                    # residuals is meaningful (Σr is the total deferred
+                    # quantization error) — fold it onto rank 0
+                    folded = np.zeros((n,) + val.shape[1:], np.float32)
+                    folded[0] = val.sum(axis=0)
+                    val = folded
+                self._params[int(i)][k] = self._put(val, P("data"))
+            elif self._leaf_sharded(k):
                 self._params[int(i)][k] = self._flat_shard_put(val)
             else:
                 self._params[int(i)][k] = self._put(np.asarray(val))
@@ -617,8 +755,14 @@ class FusedTrainStep(Unit):
         Gradient computation is shared with the accumulation half-step
         (_local_grads); the optimizer application with the deferred apply
         (_apply_update)."""
-        key, grads, metrics = self._local_grads(params, key, x, labels,
-                                                mask)
+        key, grads, metrics, new_res = self._local_grads(params, key, x,
+                                                         labels, mask)
+        if new_res is not None:
+            # fold the stepped error-feedback residuals into the params
+            # carry BEFORE the apply (_apply_update's dict(leaf) copy
+            # passes them through to the output pytree)
+            params = [{**leaf, **nr}
+                      for leaf, nr in zip(params, new_res)]
         new_params = self._apply_update(params, grads, hyper,
                                         metrics["bs"])
         return new_params, key, metrics
@@ -772,7 +916,8 @@ class FusedTrainStep(Unit):
                         self._param_shape(i, k), leaf[k].dtype))
                     sites.append((i, k))
         full = zero.gather_chain(shards, likes, rank, n, "data",
-                                 via_psum=self._gather_via_psum)
+                                 via_psum=self._gather_via_psum,
+                                 codec=self._codec)
         out = [dict(leaf) for leaf in leaves]
         for (i, k), v in zip(sites, full):
             out[i][k] = v
@@ -809,9 +954,23 @@ class FusedTrainStep(Unit):
 
         (_, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(trainable)
-        grads = jax.lax.psum(grads, "data")
+        # the grad reduction rides the quantized-psum seam: exact
+        # lax.psum when no codec (bit-identical program), int8/bf16
+        # payload + error-feedback residuals otherwise.  metrics/bs
+        # psums above/below stay exact always — telemetry and the
+        # Decision's sample accounting must never quantize.
+        residuals = None
+        if self._ef:
+            # local residual view: the (1, *shape) slab's single row
+            residuals = [{k: params[i]["r" + k][0] for k in g}
+                         for i, g in enumerate(grads)]
+        grads, res_out = quantized_psum(grads, "data", self._codec,
+                                        residuals)
+        new_res = None if res_out is None else \
+            [{"r" + k: v[None] for k, v in leaf.items()}
+             for leaf in res_out]
         metrics["bs"] = jax.lax.psum(mask.sum(), "data")
-        return key, grads, metrics
+        return key, grads, metrics, new_res
 
     def _local_grads_idx(self, params, key, data, labels, idx, mask):
         return self._local_grads(params, key, data[idx], labels[idx], mask)
@@ -882,6 +1041,11 @@ class FusedTrainStep(Unit):
         # all_gather, so a caller re-enabling check_vma needs this)
         self._gather_via_psum = bool(root.common.engine.get(
             "zero_gather_via_psum", False))
+        # quantized collectives (ISSUE 18): resolve BEFORE gather_params
+        # — the error-feedback residual leaves must exist in the pytree
+        # the specs and programs are built from
+        self._codec = qcomm.resolve(self.quantized_collectives)
+        self._ef = self._codec is not None and self._codec.error_feedback
         self._params = self.gather_params()
         self._account_zero_memory()
         self._key = self._put(prng.get().key())
@@ -899,7 +1063,8 @@ class FusedTrainStep(Unit):
         if self.accumulate_steps > 1:
             gradf = shard_map(self._local_grads, mesh=self.mesh,
                               in_specs=(pspecs, rep, sh, sh, sh),
-                              out_specs=(rep, rep, rep))
+                              out_specs=(rep, rep, rep,
+                                         self._res_specs()))
             applyf = shard_map(self._local_apply, mesh=self.mesh,
                                in_specs=(pspecs, rep, rep, rep),
                                out_specs=pspecs)
@@ -991,7 +1156,8 @@ class FusedTrainStep(Unit):
         if self.accumulate_steps > 1:
             gradf = shard_map(self._local_grads_idx, mesh=self.mesh,
                               in_specs=(pspecs, rep, rep, rep, sh, sh),
-                              out_specs=(rep, rep, rep))
+                              out_specs=(rep, rep, rep,
+                                         self._res_specs()))
             self._grad_fn_idx = jax.jit(gradf)
         # the loader now only needs to serve indices — its per-step host
         # gather + device upload of the minibatch would be dead work
@@ -1082,6 +1248,7 @@ class FusedTrainStep(Unit):
         self._params, self._key, metrics = self._scan_fn(
             self._params, self._key, self._hyper_device(), xs, ys, masks)
         self._note_gathered(int(xs.shape[0]))
+        self._note_qcomm_grads(int(xs.shape[0]))
         return metrics
 
     # -- input-pipeline staging ---------------------------------------------
@@ -1157,13 +1324,16 @@ class FusedTrainStep(Unit):
                 metrics = self._eval_fn_idx(self._params, data, labels_all,
                                             idx, mask)
             elif accumulate:
-                self._key, grads, metrics = self._grad_fn_idx(
+                self._key, grads, metrics, new_res = self._grad_fn_idx(
                     self._params, self._key, data, labels_all, idx, mask)
+                self._fold_residuals(new_res)
                 self._accumulate(grads, metrics, loader)
+                self._note_qcomm_grads()
             else:
                 self._params, self._key, metrics = self._train_fn_idx(
                     self._params, self._key, self._hyper_device(),
                     data, labels_all, idx, mask)
+                self._note_qcomm_grads()
             self._finish_run(loader, metrics)
             return
         if staged is not None:
@@ -1177,14 +1347,26 @@ class FusedTrainStep(Unit):
         if int(loader.minibatch_class) != TRAIN:
             metrics = self._eval_fn(self._params, x, labels, mask)
         elif accumulate:
-            self._key, grads, metrics = self._grad_fn(
+            self._key, grads, metrics, new_res = self._grad_fn(
                 self._params, self._key, x, labels, mask)
+            self._fold_residuals(new_res)
             self._accumulate(grads, metrics, loader)
+            self._note_qcomm_grads()
         else:
             self._params, self._key, metrics = self._train_fn(
                 self._params, self._key, self._hyper_device(),
                 x, labels, mask)
+            self._note_qcomm_grads()
         self._finish_run(loader, metrics)
+
+    def _fold_residuals(self, new_res) -> None:
+        """Persist the residual updates returned by a ``_grad_fn``
+        half-step into the params pytree (the full-step path folds them
+        inside the compiled program; the accumulation path returns them
+        because the apply is deferred)."""
+        if new_res is not None:
+            for leaf, nr in zip(self._params, new_res):
+                leaf.update(nr)
 
     def _accumulate(self, grads, metrics, loader) -> None:
         """Fold one half-step's summed grads into the device accumulator;
@@ -1223,6 +1405,7 @@ class FusedTrainStep(Unit):
                     self._scan_idx_fns["train"](
                         self._params, self._key, self._hyper_device(),
                         data, labels, idxs, ms)
+                self._note_qcomm_grads(int(idxs.shape[0]))
             else:
                 metrics = self._scan_idx_fns["eval"](
                     self._params, data, labels, idxs, ms)
@@ -1234,6 +1417,7 @@ class FusedTrainStep(Unit):
             self._acc = None
             self._conf_seen = None
             self._scan_in_flight = False
+            self._publish_residual_norm()
         else:
             self.n_err = 0
             self.mse = 0.0
@@ -1244,6 +1428,8 @@ class FusedTrainStep(Unit):
         # one dispatch (train, grads half-step, or eval) = one on-demand
         # full-weight regather under shard_params
         self._note_gathered()
+        if loader.last_minibatch:
+            self._publish_residual_norm()
         # chaos hook (site "step.params"): NaN-poisons the param pytree —
         # the observable effect of NaN gradients — so health-guard and
         # rollback paths are exercised against the real fused step

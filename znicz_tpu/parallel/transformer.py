@@ -25,8 +25,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from znicz_tpu.parallel.compat import shard_map
+from znicz_tpu.parallel.compat import quantized_psum, shard_map
 
+from znicz_tpu.parallel import qcomm
 from znicz_tpu.parallel.moe import (load_balance_aux, moe_ffn,
                                     router_z_loss)
 from znicz_tpu.parallel.pipeline import pipeline_apply
@@ -444,7 +445,8 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
                 moe_aux_weight: float = 0.0,
                 moe_top_k: int = 1,
                 remat_policy: str | None = None,
-                moe_zloss_weight: float = 0.0):
+                moe_zloss_weight: float = 0.0,
+                reduce: bool = True):
     """The ONE forward + CE-loss body (shared by the train step's loss_fn
     and the eval pass, so their numerics can never drift).  ``mask`` is a
     per-row validity mask or None; masked rows (the loader's padded tail)
@@ -452,7 +454,15 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     padding contract (loader/base.py).  ``moe_aux_weight`` scales the
     MoE blocks' summed load-balance aux into the loss (local-mean
     convention, same psum as the CE term; PADDED rows do count toward
-    the routing statistics — the aux is a regularizer, not a metric)."""
+    the routing statistics — the aux is a regularizer, not a metric).
+
+    ``reduce=False`` returns the LOCAL loss term whose exact
+    ``psum(..., ("data", "seq"))`` equals the ``reduce=True`` value
+    (the replicated normalizers — shard counts, the masked token total —
+    still reduce exactly inside).  The quantized-collective train step
+    uses it to differentiate a local loss and route the gradient
+    reduction through the explicit quantized psum instead of AD's
+    psum transpose."""
     x, aux_term, ps = _forward_hidden(
         ps, tokens, heads_local, causal, use_flash, interp, cdt,
         remat=remat, use_ring_flash=use_ring_flash,
@@ -477,10 +487,13 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
         nll = -picked.sum() if mvec is None else \
             -(picked * jnp.broadcast_to(mvec, picked.shape)).sum()
     if mask is None:
+        local = nll / (b_l * t_l) + aux_term
+        if not reduce:
+            return local
         # psum-of-local-means; it makes AD emit globally-reduced grads
         # for replicated params; model-sharded params get their local
         # shard's grad
-        return lax.psum(nll / (b_l * t_l) + aux_term, ("data", "seq"))
+        return lax.psum(local, ("data", "seq"))
     # masked variant, SAME n_shards-scaled convention as the unmasked
     # psum-of-local-means (the caller divides loss and grads by n_shards)
     n_seq = lax.psum(1, "seq")
@@ -489,6 +502,10 @@ def _forward_ce(ps, tokens, labels, mask, heads_local, causal, use_flash,
     # its token count reduces over "data" and multiplies by n_seq — a
     # joint psum would mix varying and invarying axis states
     total = lax.psum(mask.astype(jnp.float32).sum() * t_l, "data") * n_seq
+    if not reduce:
+        # n_shards/total are replicated, so the psum of this local term
+        # distributes back to exactly the reduce=True expression
+        return n_shards * nll / jnp.maximum(total, 1.0) + aux_term
     return n_shards * lax.psum(nll, ("data", "seq")) / \
         jnp.maximum(total, 1.0) + lax.psum(aux_term, ("data", "seq"))
 
@@ -504,7 +521,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     moe_aux_weight: float = 0.0,
                     moe_top_k: int = 1,
                     remat_policy: str | None = None,
-                    moe_zloss_weight: float = 0.0):
+                    moe_zloss_weight: float = 0.0,
+                    quantized_collectives: dict | None = None):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -571,6 +589,24 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     returned specs are :func:`shard_params_specs`; read results back
     with :func:`unshard_params_host`.  Subsumes (and refuses to compose
     with) ``shard_update``.
+
+    ``quantized_collectives`` (ISSUE 18; ``None`` defers to the
+    ``engine.quantized_collectives`` config) ships the gradient
+    reduction and the shard_params regather chain quantized
+    (parallel/qcomm.py): the loss differentiates LOCALLY and ALL grads
+    reduce through one explicit quantized psum over ``("data", "seq")``,
+    while the reported loss scalar still reduces exactly.  NOTE the
+    reduction semantics: the exact path's grads come from AD's
+    psum-transpose of the reduced loss, which applies each batch
+    shard's OWN gradient to its replica; the quantized path's explicit
+    psum applies the true batch-mean gradient instead — on a
+    ``model=1`` mesh its trajectory matches a single-device full-batch
+    run to within codec noise (pinned in the flag fuzz), where the
+    exact path's does not.  The two paths therefore track each other
+    within a band, not bitwise.  No error feedback here: the step is
+    stateless (pure ``(params, batch) -> params``), so there is no
+    residual carry; prefer bf16 mode or the fused step for EF-grade
+    convergence.  mode=off builds today's program bit for bit.
     """
     if shard_params and shard_update:
         raise ValueError(
@@ -604,6 +640,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     step_specs = shard_params_specs(specs) if shard_params else specs
     via_psum = bool(root_cfg.common.engine.get("zero_gather_via_psum",
                                                False))
+    codec = qcomm.resolve(quantized_collectives)
 
     def _sharded_sgd(w, g, scale):
         """w - lr*g/scale computed on this replica's 1/n slice only,
@@ -629,7 +666,7 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                 [flat_p[i] for i in idx],
                 [jax.ShapeDtypeStruct(flat_shapes[i], flat_p[i].dtype)
                  for i in idx],
-                rank, n_data, "data", via_psum=via_psum)
+                rank, n_data, "data", via_psum=via_psum, codec=codec)
             flat_full = list(flat_p)
             for i, g in zip(idx, gathered):
                 flat_full[i] = g
@@ -646,9 +683,18 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                                moe_aux_weight=moe_aux_weight,
                                moe_top_k=moe_top_k,
                                remat_policy=remat_policy,
-                               moe_zloss_weight=moe_zloss_weight)
+                               moe_zloss_weight=moe_zloss_weight,
+                               reduce=codec is None)
 
         loss, grads = jax.value_and_grad(loss_fn)(full_params)
+        if codec is not None:
+            # quantized mode differentiates the LOCAL loss and reduces
+            # every grad leaf (replicated AND tensor-sharded — both need
+            # the data x seq sum) through the quantized-psum seam; the
+            # reported loss scalar reduces exactly (telemetry never
+            # quantizes)
+            grads, _ = quantized_psum(grads, ("data", "seq"), codec)
+            loss = lax.psum(loss, ("data", "seq"))
         n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
         if shard_params:
             # each replica updates ONLY its slice (grad sliced to match)

@@ -51,7 +51,7 @@ def psum_regather(shard, rank, n: int, axis_name: str, like):
 
 
 def all_gather_slices(shard, rank, n: int, axis_name: str, like,
-                      via_psum: bool = False):
+                      via_psum: bool = False, codec=None):
     """Disjoint per-replica flat slices -> the full array of ``like``'s
     shape, replicated, via one concatenating ``lax.all_gather`` —
     payload-proportional bytes on the wire, no zero buffer and no adds.
@@ -60,7 +60,14 @@ def all_gather_slices(shard, rank, n: int, axis_name: str, like,
     :func:`psum_regather` instead — the vma-safe fallback for callers
     running with the replication checker enabled (parallel/compat.py
     disables it by default, which is what lets the all_gather path
-    type-check)."""
+    type-check).  ``codec`` (a qcomm.Codec) ships each slice quantized
+    (int8/bf16 + chunk scales) and dequantizes on arrival — it implies
+    the all_gather wire format, so it overrides ``via_psum``; ``None``
+    keeps this exact path untouched."""
+    if codec is not None:
+        from znicz_tpu.parallel import qcomm
+        return qcomm.gather_slices(shard, rank, n, axis_name, like,
+                                   codec)
     if via_psum:
         return psum_regather(shard, rank, n, axis_name, like)
     full = jax.lax.all_gather(shard, axis_name, tiled=True)
@@ -68,7 +75,7 @@ def all_gather_slices(shard, rank, n: int, axis_name: str, like,
 
 
 def gather_chain(shards, likes, rank, n: int, axis_name: str,
-                 via_psum: bool = False):
+                 via_psum: bool = False, codec=None):
     """Materialize a list of full arrays from their per-replica slices —
     the ``shard_params`` on-demand regather chain.  Each leaf gets its
     OWN collective, dispatched in consumption order ahead of the forward
@@ -77,7 +84,9 @@ def gather_chain(shards, likes, rank, n: int, axis_name: str,
     leaf i+1's gather with leaf i's compute (the ring_attention
     overlap effect — K/V blocks in flight while the current block's
     scores compute — applied to the parameter gather chain; one fused
-    whole-tree gather would serialize instead)."""
+    whole-tree gather would serialize instead).  ``codec`` quantizes
+    every slice on the wire (per-leaf collectives keep their no-data-
+    dependency shape, so the dispatch-ahead overlap is preserved)."""
     return [all_gather_slices(s, rank, n, axis_name, like,
-                              via_psum=via_psum)
+                              via_psum=via_psum, codec=codec)
             for s, like in zip(shards, likes)]

@@ -134,6 +134,7 @@ class StandardWorkflow(StandardWorkflowBase):
                  clip_norm: Optional[float] = None,
                  accumulate_steps: int = 1,
                  ema_decay: Optional[float] = None,
+                 quantized_collectives: Optional[dict] = None,
                  **kwargs) -> None:
         super().__init__(workflow, layers=layers, **kwargs)
         if loss_function not in ("softmax", "mse"):
@@ -171,6 +172,12 @@ class StandardWorkflow(StandardWorkflowBase):
         self.accumulate_steps = accumulate_steps
         #: Polyak-averaged weight mirror maintained by the fused step
         self.ema_decay = ema_decay
+        #: quantized-collective codec config for the gradient psum and the
+        #: shard_params regather: {"mode": "off|bf16|int8", "chunk": N,
+        #: "error_feedback": bool}; None defers to
+        #: root.common.engine.quantized_collectives (docs/TUNING.md
+        #: "Quantized collectives")
+        self.quantized_collectives = quantized_collectives
         if optimizer != "sgd" and not fused:
             raise ValueError(f"optimizer {optimizer!r} requires fused=True "
                              f"(the eager gd units implement SGD only)")
@@ -189,6 +196,10 @@ class StandardWorkflow(StandardWorkflowBase):
         if ema_decay is not None and not fused:
             raise ValueError("ema_decay requires fused=True (the EMA "
                              "mirror lives in the fused step's params)")
+        if quantized_collectives is not None and not fused:
+            raise ValueError("quantized_collectives requires fused=True "
+                             "(the eager gd units psum per-unit inside "
+                             "their own programs)")
         if clip_norm is not None and clip_norm <= 0:
             raise ValueError(f"clip_norm must be positive, got {clip_norm}"
                              f" (0 freezes training; negative flips the "
@@ -319,7 +330,9 @@ class StandardWorkflow(StandardWorkflowBase):
             shard_update=self.shard_update,
             shard_params=self.shard_params, clip_norm=self.clip_norm,
             accumulate_steps=self.accumulate_steps,
-            ema_decay=self.ema_decay, name="FusedStep")
+            ema_decay=self.ema_decay,
+            quantized_collectives=self.quantized_collectives,
+            name="FusedStep")
         # re-route control: loader -> step -> decision
         step.link_from(self.loader)
         # evaluator/forwards keep their data links but leave the control
