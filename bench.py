@@ -1902,6 +1902,34 @@ def _run_child(mode: str, timeout: int, platform=None):
     return results, note
 
 
+def _sentinel_report(results, label: str) -> None:
+    """ISSUE 20: advisory perf-regression check for one scenario's
+    fresh lines against the last recorded round (report-only — the
+    hard gate is ``tools/bench_sentinel.py`` between recorded
+    ``BENCH_r*.json`` artifacts; here a cliff just gets called out on
+    stderr the moment the scenario lands instead of one round later)."""
+    rows = {str(r["metric"]): r for r in results or []
+            if isinstance(r, dict) and "metric" in r and "value" in r}
+    if not rows:
+        return
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench_sentinel",
+            os.path.join(REPO, "tools", "bench_sentinel.py"))
+        sentinel = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sentinel)
+        prev = {m: r for m, r in _prev_round_values().items()
+                if m in rows}
+        for f in sentinel.compare(prev, rows):
+            if f["kind"] in ("regression", "improvement"):
+                print(f"# sentinel [{label}]: {f['kind'].upper()} "
+                      f"{f['metric']} {f.get('prev')} -> {f.get('new')} "
+                      f"({f['detail']})", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 — advisory only
+        print(f"# sentinel unavailable: {exc!r}", file=sys.stderr)
+
+
 def main():
     notes = []
     # ISSUE 5 satellite: the r05 artifact tail showed the same metric
@@ -1932,6 +1960,7 @@ def main():
         for r in more:
             emit(r)
         results += more
+    _sentinel_report(results, "tpu")
 
     if not results:
         results, note = _run_child("cpu_fallback", CPU_TIMEOUT,
@@ -1950,6 +1979,7 @@ def main():
             if last_hw:
                 r["last_hw"] = last_hw
             emit(r)
+        _sentinel_report(results, "cpu_fallback")
 
     # serving-plane / input-pipeline / metrics-overhead scenarios: their
     # own CPU children (independent of the chip pool), BEFORE the final
@@ -1978,6 +2008,7 @@ def main():
             notes.append(note)
         for r in extra_results:
             emit(r)
+        _sentinel_report(extra_results, extra_mode)
 
     if results:
         # headline by NAME, not position: if the child was killed mid-tail

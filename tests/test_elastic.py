@@ -457,6 +457,52 @@ def test_elastic_budget_exhausts(tmp_path):
     assert len(flights) == 2               # one per failed round
 
 
+def test_goodput_ledger_accounts_supervisor_wall(tmp_path, baseline_ws1):
+    """ISSUE 20: across a seeded SIGKILL/restart drill the goodput
+    ledger's categories tile the supervisor's wall time (productive +
+    lost + snapshot + idle ≈ wall), the drill genuinely loses the
+    killed round's post-snapshot remainder, and the ledger survives
+    into the restart flight artifact as its own "goodput" plane."""
+    plan = faults.FaultPlan(seed=77).kill_at("elastic.worker",
+                                             at_hit=KILL_AT_HIT)
+    t0 = time.monotonic()
+    report = run_elastic(
+        [WORKFLOW], str(tmp_path), workers=1, spmd=False, prefix="ew",
+        policy=fast_policy(), env=worker_env(), fault_plans={0: plan},
+        term_grace=2.0, round_timeout=300.0)
+    wall = time.monotonic() - t0
+    assert report.completed and report.restarts == 1
+    assert read_history(tmp_path) == baseline_ws1    # resume still exact
+    good = report.goodput
+    assert set(good["totals"]) == {"productive", "lost", "snapshot",
+                                   "idle"}
+    rank0 = good["per_rank"]["0"]
+    accounted = sum(rank0.values())
+    # THE pin: the monotonic-cursor ledger tiles the supervisor's wall
+    # (slack only for aggregator setup before the ledger starts and the
+    # return path after its final flush)
+    assert abs(accounted - wall) <= max(0.05 * wall, 2.0), (rank0, wall)
+    assert rank0["productive"] > 0.0
+    # the killed round ran PAST its newest snapshot before dying — that
+    # remainder is the drill's genuine lost compute
+    assert rank0["lost"] > 0.0, rank0
+    assert all(v >= 0.0 for v in rank0.values())
+    assert 0.0 < good["ratio"] <= 1.0
+    # the probe families carry the same accounting (cumulative across
+    # the process, so >= this drill's figures)
+    totals = probe.goodput_totals()
+    assert totals["productive"] >= rank0["productive"] - 1e-6
+    assert totals["lost"] >= rank0["lost"] - 1e-6
+    # the restart flight artifact embeds the ledger-at-failure
+    assert report.flights
+    with open(report.flights[0]) as f:
+        doc = json.load(f)
+    plane = doc["planes"]["goodput"]
+    assert plane["per_rank"]["0"]["productive"] > 0.0
+    assert set(plane["totals"]) == {"productive", "lost", "snapshot",
+                                    "idle"}
+
+
 # -- heartbeat plumbing ------------------------------------------------------
 
 def test_heartbeat_thread_writes_progress(tmp_path):

@@ -18,3 +18,34 @@ def test_compare_traces(tmp_path):
     assert rows and all(
         set(r) == {"category", "a_ms", "b_ms", "delta_ms"} for r in rows)
     assert not any(r["category"] == "while" for r in rows)
+
+
+def test_compare_traces_one_sided_category(monkeypatch):
+    """A category present in only one trace (an op class a rewrite
+    added or fused away) diffs with its missing side at 0.0 — a
+    legitimate outcome, never a KeyError (ISSUE 20 satellite)."""
+    from znicz_tpu.utils import profiling
+
+    sides = {
+        "dir_a": [{"op": "fusion.1", "total_ms": 3.0},
+                  {"op": "convolution.2", "total_ms": 2.0}],
+        "dir_b": [{"op": "fusion.7", "total_ms": 1.5},
+                  {"op": "all-reduce.1", "total_ms": 4.0}],
+    }
+    monkeypatch.setattr(profiling, "summarize_trace",
+                        lambda logdir, top=None: sides[logdir])
+    rows = profiling.compare_traces("dir_a", "dir_b")
+    by_cat = {r["category"]: r for r in rows}
+    # shared category diffs normally
+    assert by_cat["fusion"]["a_ms"] == 3.0
+    assert by_cat["fusion"]["b_ms"] == 1.5
+    # one-sided categories: the missing side is 0.0, delta is the whole
+    # total, in both directions
+    assert by_cat["convolution"]["a_ms"] == 2.0
+    assert by_cat["convolution"]["b_ms"] == 0.0
+    assert by_cat["convolution"]["delta_ms"] == -2.0
+    assert by_cat["all-reduce"]["a_ms"] == 0.0
+    assert by_cat["all-reduce"]["b_ms"] == 4.0
+    assert by_cat["all-reduce"]["delta_ms"] == 4.0
+    # sorted by |delta|: the biggest one-sided category leads
+    assert rows[0]["category"] == "all-reduce"

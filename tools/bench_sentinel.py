@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Perf-regression sentinel over recorded bench rounds (ISSUE 20).
+
+``BENCH_r*.json`` artifacts accumulate one per driver round, but nothing
+reads them adversarially: a 20% throughput cliff lands in the repo as
+quietly as an improvement, and the only guard — ``vs_baseline`` on each
+emitted line — is advisory output a human has to notice.  This tool
+closes that loop: it diffs the NEWEST round against the prior one per
+scenario metric and fails loudly (exit 1) when any comparable metric
+moved past a configurable band in the losing direction.
+
+Usage::
+
+    python tools/bench_sentinel.py                 # newest vs prior round
+    python tools/bench_sentinel.py --band 0.15     # widen the band
+    python tools/bench_sentinel.py --report-only   # print, always exit 0
+    python tools/bench_sentinel.py OLD.json NEW.json   # explicit pair
+
+Semantics:
+
+- a round's metrics come from its ``tail`` JSON lines (the child's
+  flushed result records; later lines win per metric — bench.py's own
+  ``_prev_round_values`` discipline), falling back to the driver's
+  ``parsed`` headline when the tail carries none;
+- orientation is inferred per metric: ``seconds``/``latency``/``_time``
+  metrics regress UP, throughput (``*/sec``, ``per_sec``) regresses
+  DOWN — so the band check is direction-aware without any schema change
+  to the recorded artifacts;
+- a metric present in only one round is REPORTED (``new``/``dropped``)
+  but never fails the run: scenario sets legitimately grow per PR and a
+  one-sided row has nothing to diff;
+- a record the emitter marked non-comparable (``reached_target`` false,
+  ``vs_baseline`` == 0.0) or a non-positive value is skipped the same
+  way, and a round with ``rc != 0`` still contributes whatever lines it
+  flushed before dying (flagged in the report).
+
+Stdlib only — the sentinel must run in CI and on the bench host without
+importing jax.  bench.py imports :func:`compare` to print a per-scenario
+``# sentinel:`` line in report-only mode after each scenario.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: default relative band: |new/prev - 1| beyond this in the losing
+#: direction fails (0.10 = a 10% regression)
+DEFAULT_BAND = 0.10
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def lower_is_better(metric: str, unit: str = "") -> bool:
+    """Orientation from the metric/unit names alone: time-like metrics
+    regress upward, everything else (throughput) regresses downward."""
+    u = str(unit or "").lower()
+    m = str(metric or "").lower()
+    if "/sec" in u or "/s" == u or "per_sec" in m or "per_second" in m:
+        return False
+    if u in ("seconds", "s", "ms", "us") or "latency" in m or \
+            m.endswith("_seconds") or m.endswith("_time") or \
+            "_seconds_" in m:
+        return True
+    return False
+
+
+def load_round(path: str) -> dict:
+    """``metric -> record`` for one BENCH artifact: tail JSON lines
+    (later lines win), else the driver's ``parsed`` headline; plus the
+    pseudo-entry ``"__rc__"`` carrying the round's exit code."""
+    with open(path) as f:
+        doc = json.load(f)
+    records: dict = {}
+    for line in str(doc.get("tail", "")).splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(r, dict) and "metric" in r and "value" in r:
+            records[str(r["metric"])] = r
+    if not records:
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed and \
+                "value" in parsed:
+            records[str(parsed["metric"])] = parsed
+    records["__rc__"] = {"rc": doc.get("rc")}
+    return records
+
+
+def discover_rounds(repo: str = REPO) -> list:
+    """Sorted ``(round_no, path)`` for every BENCH_r*.json present."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = _ROUND_RE.search(path)
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def _comparable(rec: dict) -> bool:
+    try:
+        value = float(rec["value"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    if value <= 0.0:
+        return False
+    if rec.get("reached_target") is False:
+        return False
+    # the emitter stamps vs_baseline 0.0 on runs it judged
+    # non-comparable (trend_valid=False) — honor that verdict
+    if rec.get("vs_baseline") == 0.0:
+        return False
+    return True
+
+
+def compare(prev: dict, new: dict, band: float = DEFAULT_BAND) -> list:
+    """Diff two ``metric -> record`` maps -> finding dicts, each
+    ``{"metric", "kind", "detail", ...}`` with ``kind`` one of
+    ``regression`` / ``improvement`` / ``new`` / ``dropped`` /
+    ``skipped``.  Only ``regression`` findings should fail a caller."""
+    findings = []
+    prev = {k: v for k, v in prev.items() if k != "__rc__"}
+    new = {k: v for k, v in new.items() if k != "__rc__"}
+    for metric in sorted(set(prev) | set(new)):
+        p, n = prev.get(metric), new.get(metric)
+        if p is None:
+            findings.append({"metric": metric, "kind": "new",
+                             "detail": "no prior round to diff against"})
+            continue
+        if n is None:
+            findings.append({"metric": metric, "kind": "dropped",
+                             "detail": "present in prior round only"})
+            continue
+        if not _comparable(p) or not _comparable(n):
+            findings.append({"metric": metric, "kind": "skipped",
+                             "detail": "non-comparable record "
+                                       "(missing/zero value or marked "
+                                       "not-reached)"})
+            continue
+        pv, nv = float(p["value"]), float(n["value"])
+        lower = lower_is_better(metric, n.get("unit", p.get("unit", "")))
+        ratio = nv / pv
+        # loss is always expressed as a positive fraction past the band
+        loss = (ratio - 1.0) if lower else (1.0 - ratio)
+        base = {"metric": metric, "prev": pv, "new": nv,
+                "ratio": round(ratio, 4),
+                "orientation": "lower" if lower else "higher"}
+        if loss > band:
+            findings.append({**base, "kind": "regression",
+                             "detail": f"{loss:+.1%} past the "
+                                       f"{band:.0%} band"})
+        elif loss < -band:
+            findings.append({**base, "kind": "improvement",
+                             "detail": f"{-loss:+.1%}"})
+        else:
+            findings.append({**base, "kind": "ok",
+                             "detail": f"within band ({loss:+.1%})"})
+    return findings
+
+
+def render(findings: list, label: str = "") -> str:
+    head = f"sentinel{f' [{label}]' if label else ''}: "
+    if not findings:
+        return head + "nothing to diff"
+    lines = []
+    for f in findings:
+        bits = f"{f['kind'].upper():11s} {f['metric']}"
+        if "prev" in f:
+            bits += (f"  {f['prev']:g} -> {f['new']:g} "
+                     f"(x{f['ratio']:g}, {f['orientation']}-is-better)")
+        lines.append(head + bits + f" — {f['detail']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff the newest BENCH_r*.json against the prior "
+                    "round and fail past the regression band")
+    p.add_argument("files", nargs="*",
+                   help="explicit OLD.json NEW.json pair (default: the "
+                        "two newest BENCH_r*.json in the repo)")
+    p.add_argument("--band", type=float, default=DEFAULT_BAND,
+                   help=f"relative regression band "
+                        f"(default {DEFAULT_BAND:g})")
+    p.add_argument("--report-only", action="store_true",
+                   help="print findings but always exit 0")
+    p.add_argument("--repo", default=REPO,
+                   help="repo root to scan for BENCH_r*.json")
+    args = p.parse_args(argv)
+
+    if args.files:
+        if len(args.files) != 2:
+            p.error("pass exactly two files: OLD.json NEW.json")
+        old_path, new_path = args.files
+        label = (f"{os.path.basename(old_path)} -> "
+                 f"{os.path.basename(new_path)}")
+    else:
+        rounds = discover_rounds(args.repo)
+        if len(rounds) < 2:
+            print("sentinel: fewer than two BENCH rounds recorded; "
+                  "nothing to diff", file=sys.stderr)
+            return 0
+        (_, old_path), (_, new_path) = rounds[-2], rounds[-1]
+        label = (f"r{rounds[-2][0]:02d} -> r{rounds[-1][0]:02d}")
+
+    prev, new = load_round(old_path), load_round(new_path)
+    for name, rec in (("prior", prev), ("newest", new)):
+        rc = rec.get("__rc__", {}).get("rc")
+        if rc not in (0, None):
+            print(f"sentinel: {name} round exited rc={rc}; diffing "
+                  f"whatever it flushed", file=sys.stderr)
+    findings = compare(prev, new, band=args.band)
+    print(render(findings, label=label))
+    regressions = [f for f in findings if f["kind"] == "regression"]
+    if regressions and not args.report_only:
+        print(f"sentinel: {len(regressions)} regression(s) past the "
+              f"{args.band:.0%} band", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -852,6 +852,64 @@ def any_rank_recompile_storm(rank: int, max_in_window: float = 3.0,
                     f"inside {window_s:g}s ({metric})")
 
 
+def rank_straggler(rank: int, peers: Sequence[Rule],
+                   spread: float = 1.5, window_s: float = 60.0,
+                   min_count: int = 4,
+                   metric: str = "znicz_anatomy_step_seconds",
+                   action: Optional[Callable] = None) -> Rule:
+    """ONE rank's windowed step-time median above ``spread``x the
+    median of its PEERS' medians (ISSUE 20 straggler watch) — the
+    SPMD failure mode no single-rank rule can see: every collective
+    runs at the slowest rank's pace, so one degraded worker (thermal
+    throttle, a sick host, an unlucky NUMA layout) silently taxes the
+    whole fleet while its own absolute numbers still look plausible.
+
+    Relative-to-peers rather than an absolute threshold: the fleet is
+    its own baseline, so the rule needs no per-model tuning.  Each
+    rank's rule reduces its OWN rank-filtered
+    ``znicz_anatomy_step_seconds`` buckets to a windowed p50, then the
+    predicate compares against the median of the sibling rules'
+    ``last_value`` — ``peers`` is the shared (mutable) list of all the
+    fleet's straggler rules, read at evaluation time, so build through
+    :func:`add_straggler_rules` rather than by hand.  With fewer than
+    two peers reporting there is no baseline and the rule stays quiet.
+    """
+    name = f"rank_straggler[{rank}]"
+
+    def predicate(own_p50: float) -> bool:
+        others = sorted(r.last_value for r in peers
+                        if r.name != name and r.last_value is not None)
+        if not others:
+            return False
+        mid = len(others) // 2
+        peer_median = others[mid] if len(others) % 2 else \
+            0.5 * (others[mid - 1] + others[mid])
+        return peer_median > 0.0 and own_p50 > spread * peer_median
+
+    return Rule(
+        name, f'{metric}{{rank="{rank}"}}',
+        predicate, window_s=window_s, reduce="window_quantile",
+        quantile=0.5, min_count=min_count, action=action,
+        description=f"rank {rank} windowed step p50 > {spread:g}x the "
+                    f"peer-median p50 over {window_s:g}s ({metric})")
+
+
+def add_straggler_rules(aggregator: "FleetAggregator", *,
+                        spread: float = 1.5, window_s: float = 60.0,
+                        min_count: int = 4,
+                        metric: str = "znicz_anatomy_step_seconds",
+                        action: Optional[Callable] = None) -> list:
+    """Install one :func:`rank_straggler` per registered source and
+    wire their shared peer list — the factory's baseline is the OTHER
+    rules' last windowed p50, so the rules must know each other."""
+    peers: list = []
+    peers.extend(aggregator.add_rule_per_rank(
+        lambda rank: rank_straggler(
+            rank, peers, spread=spread, window_s=window_s,
+            min_count=min_count, metric=metric, action=action)))
+    return list(peers)
+
+
 #: rolling id for requests minted at HTTP admission — combined with the
 #: pid so ids stay unique across a worker fleet without coordination
 _RID_SEQ = itertools.count(1)

@@ -471,3 +471,83 @@ def elastic_counts() -> dict:
             "worker_deaths": int(deaths),
             "resumes": int(_ELASTIC_RESUMES.get()),
             "world_size": int(_ELASTIC_WORLD.get())}
+
+
+# -- step anatomy (ISSUE 20) -------------------------------------------------
+
+
+def anatomy_phase(plane: str, phase: str, dt_s: float,
+                  t0: Optional[float] = None) -> None:
+    """One already-timed anatomy phase from a producer that owns its
+    own clock (prefetcher input-wait/stage, the continuous batcher's
+    prefill/decode/verify).  Thin delegate so producers only import
+    probe; the import is lazy to keep anatomy off probe's module-load
+    path."""
+    if not _enabled:
+        return
+    from znicz_tpu.observe import anatomy as _anatomy
+    _anatomy.observe_phase(plane, phase, dt_s, t0=t0)
+
+
+# -- goodput ledger (ISSUE 20; supervisor-side, like the elastic plane) ------
+
+_GOODPUT_PRODUCTIVE = _reg.counter(
+    "znicz_goodput_productive_seconds_total",
+    "per-rank wall seconds the elastic fleet spent making step progress "
+    "(completed rounds + failed-round time covered by a later-valid "
+    "snapshot)", labelnames=("rank",))
+_GOODPUT_LOST = _reg.counter(
+    "znicz_goodput_lost_seconds_total",
+    "per-rank wall seconds of work discarded by a failure (failed-round "
+    "time past the newest valid snapshot — recomputed after restart)",
+    labelnames=("rank",))
+_GOODPUT_SNAPSHOT = _reg.counter(
+    "znicz_goodput_snapshot_seconds_total",
+    "per-rank wall seconds inside teardown/snapshot grace windows "
+    "(SIGTERM grace, snapshot-then-exit)", labelnames=("rank",))
+_GOODPUT_IDLE = _reg.counter(
+    "znicz_goodput_idle_seconds_total",
+    "per-rank wall seconds with no fleet running (spawn windows, "
+    "restart backoff, flight dumps)", labelnames=("rank",))
+_GOODPUT_RATIO = _reg.gauge(
+    "znicz_goodput_ratio",
+    "productive / (productive + lost + snapshot + idle) over the "
+    "supervisor's lifetime — the fleet-level goodput figure")
+
+_GOODPUT = {"productive": _GOODPUT_PRODUCTIVE, "lost": _GOODPUT_LOST,
+            "snapshot": _GOODPUT_SNAPSHOT, "idle": _GOODPUT_IDLE}
+
+
+def goodput_pretouch(ranks) -> None:
+    """Materialize every goodput child before the first fleet sample
+    (PR 11 delta-rule lesson — see ``anatomy.pretouch``)."""
+    for rank in ranks:
+        for fam in _GOODPUT.values():
+            fam.labels(rank=str(rank)).inc(0.0)
+    _GOODPUT_RATIO.set(0.0)
+
+
+def goodput_note(category: str, rank, dt_s: float) -> None:
+    """Donate ``dt_s`` wall seconds of ``category`` (productive | lost |
+    snapshot | idle) for one rank.  Recorded even while probes are
+    disabled (the zero_memory precedent): the goodput drill must stay
+    assertable through a bench's bare arm, and the supervisor's round
+    bookkeeping is never on a per-signal hot path."""
+    if dt_s <= 0.0:
+        return
+    fam = _GOODPUT.get(category)
+    if fam is None:
+        raise ValueError(f"unknown goodput category: {category!r}")
+    fam.labels(rank=str(rank)).inc(float(dt_s))
+    total = sum(child.get() for f in _GOODPUT.values()
+                for _, child in f.items())
+    if total > 0.0:
+        _GOODPUT_RATIO.set(
+            sum(c.get() for _, c in _GOODPUT_PRODUCTIVE.items()) / total)
+
+
+def goodput_totals() -> dict:
+    """Per-category second sums across ranks — what the elastic drill
+    reconciles against supervisor wall time."""
+    return {cat: float(sum(child.get() for _, child in fam.items()))
+            for cat, fam in _GOODPUT.items()}

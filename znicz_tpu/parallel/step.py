@@ -112,8 +112,19 @@ class FusedTrainStep(Unit):
                  accumulate_steps: int = 1,
                  ema_decay: Optional[float] = None,
                  quantized_collectives: Optional[dict] = None,
+                 anatomy: Optional[bool] = None,
                  **kwargs) -> None:
         super().__init__(workflow, **kwargs)
+        #: step-anatomy split-dispatch mode (ISSUE 20): the train step
+        #: runs as SEPARATE compiled programs per phase (zero_gather /
+        #: grad / collective / update) with host stamps between them,
+        #: feeding znicz_anatomy_* (observe/anatomy.py).  Numerics match
+        #: the fused path (same loss_fn, same explicit grad psum, same
+        #: apply); the cost is per-phase dispatch latency + a
+        #: materialized full-weight output under shard_params — a
+        #: diagnostic mode, never the perf path.  ``None`` defers to
+        #: ``root.common.engine.step_anatomy`` (False).
+        self.anatomy = anatomy
         #: quantized-collective codec config (ISSUE 18, EQuARX-style):
         #: ``{"mode": "off|bf16|int8", "chunk": N, "error_feedback":
         #: bool}`` — the gradient psum and (under shard_params) the
@@ -238,6 +249,11 @@ class FusedTrainStep(Unit):
         self._qcomm_gather_bytes = None  # (wire, exact) per dispatch
         self._qcomm_grad_counters = None
         self._qcomm_gather_counters = None
+        self._anatomy = None      # StepAnatomy accountant (anatomy mode)
+        self._anat_gather_fn = None   # split programs (anatomy mode)
+        self._anat_grad_fn = None
+        self._anat_collective_fn = None
+        self._anat_update_fn = None
         self._acc = None          # device-side metric sums (deferred mode)
         self._conf_seen = None    # confusion sums already folded this pass
         self._nt_valid = None     # nearest-target recovery proven valid?
@@ -996,6 +1012,135 @@ class FusedTrainStep(Unit):
     def _local_eval_idx(self, params, data, labels, idx, mask):
         return self._local_eval(params, data[idx], labels[idx], mask)
 
+    # -- step anatomy (ISSUE 20): split-dispatch phase programs --------------
+    def _trainable_specs(self, spec):
+        """Specs pytree matching the trainable (w/b-only) subtree."""
+        return [{k: spec for k in ("w", "b") if k in leaf}
+                for leaf in self._params]
+
+    def _build_anatomy(self) -> None:
+        """Compile the per-phase programs the anatomy mode dispatches
+        sequentially: the SAME bodies as ``_local_train`` — gather, then
+        ``loss_fn``+grad, then the explicit (possibly quantized) psum,
+        then ``_apply_update`` — cut at the phase seams.  The grad
+        program returns per-rank UNREDUCED grads as a stacked
+        ``(n, *shape)`` array via the ``g[None]`` / out_specs
+        ``P("data")`` trick (each rank's slice stays on its device: no
+        data movement at the cut), and the collective program takes the
+        stack back per-rank and runs the identical ``quantized_psum``
+        seam — grads, error-feedback residuals and the update follow
+        exactly the fused program's math (parity to float tolerance:
+        XLA may fuse/reassociate differently across the program cuts,
+        which test_anatomy pins)."""
+        from znicz_tpu.observe.anatomy import StepAnatomy, TRAIN_PHASES
+
+        rep, sh = P(), P("data")
+        pspecs = self.param_specs()
+        t_rep = self._trainable_specs(rep)
+        t_stacked = self._trainable_specs(sh)
+
+        def local_gather(params):
+            trainable = [{k: v for k, v in leaf.items()
+                          if k in ("w", "b")} for leaf in params]
+            return self._gather_full(trainable)
+
+        def local_grad(trainable, key, x, labels, mask):
+            key, sub = jax.random.split(key)
+            rng = jax.random.fold_in(sub, jax.lax.axis_index("data"))
+
+            def loss_fn(ps):
+                out, logits_tail = self._forward_chain(ps, x, train=True,
+                                                       rng=rng)
+                loss, metrics = self._loss_and_metrics(
+                    out, logits_tail, labels, mask)
+                metrics = jax.lax.psum(metrics, "data")
+                return loss, metrics
+
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(trainable)
+            stacked = [{k: v[None] for k, v in leaf.items()}
+                       for leaf in grads]
+            metrics["bs"] = jax.lax.psum(mask.sum(), "data")
+            return key, stacked, metrics
+
+        def local_collective(params, stacked):
+            grads = [{k: v[0] for k, v in leaf.items()}
+                     for leaf in stacked]
+            residuals = None
+            if self._ef:
+                residuals = [{k: params[i]["r" + k][0] for k in g}
+                             for i, g in enumerate(grads)]
+            grads, res_out = quantized_psum(grads, "data", self._codec,
+                                            residuals)
+            new_res = None if res_out is None else \
+                [{"r" + k: v[None] for k, v in leaf.items()}
+                 for leaf in res_out]
+            return grads, new_res
+
+        if self.shard_params:
+            gatherf = shard_map(local_gather, mesh=self.mesh,
+                                in_specs=(pspecs,), out_specs=t_rep)
+            self._anat_gather_fn = jax.jit(gatherf)
+        gradf = shard_map(local_grad, mesh=self.mesh,
+                          in_specs=(t_rep, rep, sh, sh, sh),
+                          out_specs=(rep, t_stacked, rep))
+        self._anat_grad_fn = jax.jit(gradf)
+        collf = shard_map(local_collective, mesh=self.mesh,
+                          in_specs=(pspecs, t_stacked),
+                          out_specs=(t_rep, self._res_specs()))
+        self._anat_collective_fn = jax.jit(collf)
+        if self._ef:
+            def local_update(params, hyper, grads, bs, new_res):
+                params = [{**leaf, **nr}
+                          for leaf, nr in zip(params, new_res)]
+                return self._apply_update(params, grads, hyper, bs)
+            updf = shard_map(local_update, mesh=self.mesh,
+                             in_specs=(pspecs, rep, t_rep, rep,
+                                       self._res_specs()),
+                             out_specs=pspecs)
+        else:
+            updf = shard_map(self._local_apply, mesh=self.mesh,
+                             in_specs=(pspecs, rep, t_rep, rep),
+                             out_specs=pspecs)
+        self._anat_update_fn = jax.jit(updf)
+        self._anatomy = StepAnatomy("fused", TRAIN_PHASES)
+        if self.loader is not None:
+            from znicz_tpu.utils import flops as _flops
+            self._anatomy.set_flops(_flops.train_step_flops(
+                self.forwards, int(self.loader.max_minibatch_size)))
+
+    def _run_anatomy_step(self, x, labels, mask):
+        """Anatomy-mode train dispatch: one program per phase, host
+        stamps at the ``block_until_ready`` boundaries.  Returns the
+        metrics pytree the fused program would have returned."""
+        anat = self._anatomy
+        anat.begin()
+        if self.shard_params:
+            trainable = jax.block_until_ready(
+                self._anat_gather_fn(self._params))
+            anat.stamp("zero_gather")
+        else:
+            trainable = [{k: leaf[k] for k in ("w", "b") if k in leaf}
+                         for leaf in self._params]
+        key, stacked, metrics = jax.block_until_ready(
+            self._anat_grad_fn(trainable, self._key, x, labels, mask))
+        anat.stamp("grad")
+        grads, new_res = jax.block_until_ready(
+            self._anat_collective_fn(self._params, stacked))
+        anat.stamp("collective")
+        hyper = self._hyper_device()
+        if self._ef:
+            params = self._anat_update_fn(self._params, hyper, grads,
+                                          metrics["bs"], new_res)
+        else:
+            params = self._anat_update_fn(self._params, hyper, grads,
+                                          metrics["bs"])
+        jax.block_until_ready(params)
+        anat.stamp("update")
+        self._params, self._key = params, key
+        anat.finish()
+        return metrics
+
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, device=None, **kwargs) -> None:
         # the step subsumes the segment units: they are not in the control
@@ -1071,6 +1216,19 @@ class FusedTrainStep(Unit):
             self._grad_fn = jax.jit(gradf)
             self._apply_fn = jax.jit(
                 applyf, donate_argnums=(0,) if self.donate else ())
+        self.anatomy = bool(
+            self.anatomy if self.anatomy is not None
+            else root.common.engine.get("step_anatomy", False))
+        if self.anatomy:
+            # split-dispatch diagnostics are a per-minibatch mode: the
+            # accumulate/scan paths batch many steps into one dispatch,
+            # which a host-stamped split cannot attribute — refuse
+            # instead of silently accounting garbage
+            if self.accumulate_steps > 1:
+                raise ValueError("anatomy (split-dispatch step "
+                                 "accounting) requires "
+                                 "accumulate_steps == 1")
+            self._build_anatomy()
         self._pin_dataset()
         if self._scan_idx_fns:
             # VERDICT r5 item 6: in epoch-scan mode hyperparams are read
@@ -1106,7 +1264,8 @@ class FusedTrainStep(Unit):
         label = type(self).__name__
         for attr in ("_train_fn", "_eval_fn", "_grad_fn", "_apply_fn",
                      "_train_fn_idx", "_eval_fn_idx", "_grad_fn_idx",
-                     "_scan_fn"):
+                     "_scan_fn", "_anat_gather_fn", "_anat_grad_fn",
+                     "_anat_collective_fn", "_anat_update_fn"):
             fn = getattr(self, attr, None)
             if fn is not None:
                 setattr(self, attr, _probe.time_compiles(label, fn))
@@ -1115,7 +1274,9 @@ class FusedTrainStep(Unit):
         fns = [getattr(self, n, None) for n in
                ("_train_fn", "_eval_fn", "_grad_fn", "_apply_fn",
                 "_train_fn_idx", "_eval_fn_idx", "_grad_fn_idx",
-                "_scan_fn")] + list(self._scan_idx_fns.values())
+                "_scan_fn", "_anat_gather_fn", "_anat_grad_fn",
+                "_anat_collective_fn", "_anat_update_fn")] + \
+            list(self._scan_idx_fns.values())
         _probe.watch_compiles(f"{type(self).__name__}-{id(self):x}",
                               *(f for f in fns if f is not None),
                               label=label)
@@ -1129,6 +1290,10 @@ class FusedTrainStep(Unit):
         GiB) and on the loader exposing ``original_data``."""
         self._dataset_dev = None
         self._train_fn_idx = self._eval_fn_idx = None
+        if self.anatomy:
+            # the index-fed/scan fast paths batch work the split cannot
+            # attribute; anatomy keeps the standard per-minibatch path
+            return
         loader = self.loader
         data_arr, labels_arr, _why = full_batch_arrays(
             loader, mse=isinstance(self.evaluator, EvaluatorMSE))
@@ -1351,6 +1516,9 @@ class FusedTrainStep(Unit):
                 self._params, self._key, x, labels, mask)
             self._fold_residuals(new_res)
             self._accumulate(grads, metrics, loader)
+            self._note_qcomm_grads()
+        elif self._anatomy is not None:
+            metrics = self._run_anatomy_step(x, labels, mask)
             self._note_qcomm_grads()
         else:
             self._params, self._key, metrics = self._train_fn(

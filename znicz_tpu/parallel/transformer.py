@@ -522,7 +522,8 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
                     moe_top_k: int = 1,
                     remat_policy: str | None = None,
                     moe_zloss_weight: float = 0.0,
-                    quantized_collectives: dict | None = None):
+                    quantized_collectives: dict | None = None,
+                    anatomy: bool = False):
     """-> jitted ``step(params, tokens, labels) -> (params, loss)``
     (``masked=True``: ``step(params, tokens, labels, mask)`` with a
     per-row bool mask — padded loader rows train nothing).
@@ -607,6 +608,18 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     stateless (pure ``(params, batch) -> params``), so there is no
     residual carry; prefer bf16 mode or the fused step for EF-grade
     convergence.  mode=off builds today's program bit for bit.
+
+    ``anatomy=True`` (ISSUE 20) returns a split-dispatch DRIVER instead
+    of one jitted program: separate compiled phases (zero_gather / grad
+    / collective / update) with host stamps between them feeding
+    ``znicz_anatomy_*{plane="transformer"}``.  The reduction follows
+    the quantized-collectives semantics (local loss + one explicit
+    psum — the true batch-mean gradient) even with no codec, because
+    the exact path's AD-transposed grads are per-rank PARTIAL values
+    that cannot cross a program cut; trajectories therefore track the
+    exact path within the band documented above, not bitwise.  A
+    diagnostic mode — per-phase dispatch latency is the price;
+    ``donate`` is ignored (params feed two programs per step).
     """
     if shard_params and shard_update:
         raise ValueError(
@@ -732,10 +745,147 @@ def make_train_step(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,
     batch_spec = P("data", "seq")
     in_specs = (step_specs, batch_spec, batch_spec) + \
         ((P("data"),) if masked else ())
-    step = shard_map(
-        local_step, mesh=mesh, in_specs=in_specs,
-        out_specs=(step_specs, P()))
-    return jax.jit(step, donate_argnums=(0,) if donate else ()), step_specs
+    if not anatomy:
+        step = shard_map(
+            local_step, mesh=mesh, in_specs=in_specs,
+            out_specs=(step_specs, P()))
+        return jax.jit(step, donate_argnums=(0,) if donate else ()), \
+            step_specs
+    return _make_anatomy_step(
+        mesh, specs, step_specs, shapes, batch_spec, masked, lr,
+        shard_params, shard_update, n_data, via_psum, codec,
+        _sharded_sgd,
+        dict(heads_local=heads_local, causal=causal, use_flash=use_flash,
+             interp=interp, cdt=cdt, remat=remat,
+             loss_chunks=loss_chunks, use_ring_flash=use_ring_flash,
+             head_sharded=head_sharded, moe_aux_weight=moe_aux_weight,
+             moe_top_k=moe_top_k, remat_policy=remat_policy,
+             moe_zloss_weight=moe_zloss_weight)), step_specs
+
+
+def _make_anatomy_step(mesh, specs, step_specs, shapes, batch_spec,
+                       masked, lr, shard_params, shard_update, n_data,
+                       via_psum, codec, sharded_sgd, fwd_kw):
+    """Split-dispatch phase programs + host-stamping driver for
+    ``make_train_step(anatomy=True)`` — the same gather / loss_fn /
+    psum / update bodies as ``local_step``, cut at the phase seams.
+    The grad program returns per-rank UNREDUCED grads stacked over the
+    combined ``(data, seq)`` ranks via the ``g[None]`` / out_specs
+    ``P(("data","seq"), ...)`` trick (no data movement at the cut);
+    the collective program takes the stack back per-rank and runs the
+    explicit (possibly quantized) psum."""
+    from znicz_tpu.observe.anatomy import StepAnatomy, TRAIN_PHASES
+
+    is_spec = lambda s: isinstance(s, P)  # noqa: E731
+    stacked_specs = jax.tree.map(lambda s: P(("data", "seq"), *s),
+                                 specs, is_leaf=is_spec)
+
+    def local_gather(params):
+        rank = lax.axis_index("data")
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_s = _spec_leaves(specs)
+        flat_shapes = _shape_leaves(shapes)
+        idx = [i for i, s in enumerate(flat_s) if s == P()]
+        gathered = zero.gather_chain(
+            [flat_p[i] for i in idx],
+            [jax.ShapeDtypeStruct(flat_shapes[i], flat_p[i].dtype)
+             for i in idx],
+            rank, n_data, "data", via_psum=via_psum, codec=codec)
+        flat_full = list(flat_p)
+        for i, g in zip(idx, gathered):
+            flat_full[i] = g
+        return jax.tree.unflatten(treedef, flat_full)
+
+    def local_grad(full_params, tokens, labels, mask=None):
+        def loss_fn(ps):
+            return _forward_ce(ps, tokens, labels, mask,
+                               reduce=False, **fwd_kw)
+
+        loss, grads = jax.value_and_grad(loss_fn)(full_params)
+        n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
+        loss = lax.psum(loss, ("data", "seq")) / n_shards
+        return jax.tree.map(lambda g: g[None], grads), loss
+
+    def local_collective(stacked):
+        grads = jax.tree.map(lambda g: g[0], stacked)
+        grads, _ = quantized_psum(grads, ("data", "seq"), codec)
+        return grads
+
+    def local_update(params, grads):
+        n_shards = lax.psum(1, "data") * lax.psum(1, "seq")
+        if shard_params:
+            rank = lax.axis_index("data")
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_g = jax.tree.leaves(grads)
+            flat_s = _spec_leaves(specs)
+            new_leaves = [
+                flat_p[i] - lr * zero.pad_slice(flat_g[i], rank,
+                                                n_data) / n_shards
+                if flat_s[i] == P()
+                else flat_p[i] - lr * flat_g[i] / n_shards
+                for i in range(len(flat_p))]
+            return jax.tree.unflatten(treedef, new_leaves)
+        if shard_update:
+            flat_w, treedef = jax.tree.flatten(params)
+            flat_g = jax.tree.leaves(grads)
+            flat_s = _spec_leaves(specs)
+            new_leaves = [
+                sharded_sgd(w, g, n_shards) if s == P()
+                else w - lr * g / n_shards
+                for w, g, s in zip(flat_w, flat_g, flat_s)]
+            return jax.tree.unflatten(treedef, new_leaves)
+        return jax.tree.map(lambda w, g: w - lr * g / n_shards,
+                            params, grads)
+
+    gather_fn = None
+    if shard_params:
+        gather_fn = jax.jit(shard_map(
+            local_gather, mesh=mesh, in_specs=(step_specs,),
+            out_specs=specs))
+    grad_in = (specs, batch_spec, batch_spec) + \
+        ((P("data"),) if masked else ())
+    grad_fn = jax.jit(shard_map(
+        local_grad, mesh=mesh, in_specs=grad_in,
+        out_specs=(stacked_specs, P())))
+    coll_fn = jax.jit(shard_map(
+        local_collective, mesh=mesh, in_specs=(stacked_specs,),
+        out_specs=specs))
+    upd_fn = jax.jit(shard_map(
+        local_update, mesh=mesh, in_specs=(step_specs, specs),
+        out_specs=step_specs))
+
+    anat = StepAnatomy("transformer", TRAIN_PHASES)
+    # analytic MFU numerator: ~6 FLOPs per matmul weight per token for
+    # one train step (2 fwd + 4 bwd), embedding lookup excluded — the
+    # standard transformer approximation; tokens.size (the GLOBAL
+    # batch x time) is known at the first call
+    flat_shapes = _shape_leaves(shapes)
+    matmul_params = sum(int(np.prod(s)) for s in flat_shapes
+                        if len(s) >= 2)
+    matmul_params -= int(np.prod(shapes["emb"]))
+    state = {"flops_set": False}
+
+    def step(params, tokens, labels, mask=None):
+        if not state["flops_set"]:
+            anat.set_flops(6.0 * matmul_params * int(tokens.size))
+            state["flops_set"] = True
+        anat.begin()
+        if gather_fn is not None:
+            full = jax.block_until_ready(gather_fn(params))
+            anat.stamp("zero_gather")
+        else:
+            full = params
+        args = (tokens, labels) + ((mask,) if masked else ())
+        stacked, loss = jax.block_until_ready(grad_fn(full, *args))
+        anat.stamp("grad")
+        grads = jax.block_until_ready(coll_fn(stacked))
+        anat.stamp("collective")
+        new_params = jax.block_until_ready(upd_fn(params, grads))
+        anat.stamp("update")
+        anat.finish()
+        return new_params, loss
+
+    return step
 
 
 def make_eval_loss(mesh: Mesh, n_layers: int, d: int, heads: int, ff: int,

@@ -238,6 +238,10 @@ class BatchPrefetcher:
                     if observed:
                         _M_STAGE.inc(stage_dt)
                         probe.staged_bytes(int(nbytes))
+                        # anatomy plane (ISSUE 20): the H2D staging leg
+                        # as a phase of the input pipeline's step
+                        probe.anatomy_phase("pipeline", "stage",
+                                            stage_dt, t0=t0)
                 batch = StagedBatch(rec, arrays, staged)
                 t0 = time.perf_counter()
                 while not self._stop.is_set():
@@ -306,6 +310,10 @@ class BatchPrefetcher:
         if probe.enabled():
             _M_CONS_STALL.inc(stall_dt)
             _M_CONSUMED.inc()
+            # anatomy plane (ISSUE 20): consumer-side input wait — the
+            # time the step sat blocked on an empty prefetch ring
+            probe.anatomy_phase("pipeline", "input_wait", stall_dt,
+                                t0=t0)
         if batch.record["epoch_ended"]:
             self._pending_release = True
         return batch

@@ -214,6 +214,95 @@ class WorkerProcess:
 _Worker = WorkerProcess
 
 
+class GoodputLedger:
+    """Supervisor wall-time attribution (ISSUE 20): every second of the
+    fleet's life is charged to exactly one category per rank —
+
+    ==============  ====================================================
+    ``productive``  round run windows that fed a surviving snapshot
+                    (completed rounds fully; failed rounds up to the
+                    newest valid snapshot's mtime)
+    ``lost``        a failed round's remainder past that snapshot — the
+                    compute a resume re-does
+    ``snapshot``    teardown grace windows (SIGTERM is the launcher's
+                    snapshot-then-exit)
+    ``idle``        spawn windows, flight dumps, restart backoff — the
+                    supervisor's own overhead
+    ==============  ====================================================
+
+    A monotonic cursor guarantees the categories tile the wall: each
+    :meth:`advance` charges exactly cursor->now, so per rank the four
+    sums reconstruct the supervisor's wall time (pinned by
+    tests/test_elastic.py).  Every segment is donated to the
+    ``znicz_goodput_*`` probe families, and :meth:`as_dict` doubles as
+    the flight recorder's ``goodput`` plane, so a restart artifact
+    carries the ledger of the round it post-mortems."""
+
+    CATEGORIES = ("productive", "lost", "snapshot", "idle")
+
+    def __init__(self) -> None:
+        self.started = time.monotonic()
+        self._cursor = self.started
+        self._ranks: tuple = (0,)
+        self.per_rank: dict = {}
+
+    def _charge(self, category: str, dt: float) -> None:
+        if dt <= 0.0:
+            return
+        for rank in self._ranks:
+            cats = self.per_rank.setdefault(
+                str(rank), dict.fromkeys(self.CATEGORIES, 0.0))
+            cats[category] += dt
+            _probe.goodput_note(category, rank, dt)
+
+    def advance(self, category: str, ranks=None,
+                until: Optional[float] = None) -> float:
+        """Charge cursor->``until`` (default: now) as ``category`` to
+        ``ranks`` (default: the previous segment's ranks)."""
+        if ranks is not None:
+            self._ranks = tuple(ranks) or (0,)
+        now = time.monotonic() if until is None else until
+        dt = now - self._cursor
+        self._cursor = max(self._cursor, now)
+        self._charge(category, dt)
+        return dt
+
+    def advance_split(self, boundary_s: float, before: str, after: str,
+                      ranks=None) -> float:
+        """Charge cursor->now as two categories: the first
+        ``boundary_s`` seconds as ``before``, the remainder as
+        ``after`` — the failed-round split (productive up to the
+        surviving snapshot, lost past it).  A stale snapshot from an
+        earlier round arrives as a negative/zero boundary and the whole
+        window lands in ``after``."""
+        if ranks is not None:
+            self._ranks = tuple(ranks) or (0,)
+        now = time.monotonic()
+        dt = max(0.0, now - self._cursor)
+        self._cursor = max(self._cursor, now)
+        head = min(max(0.0, boundary_s), dt)
+        self._charge(before, head)
+        self._charge(after, dt - head)
+        return dt
+
+    def totals(self) -> dict:
+        out = dict.fromkeys(self.CATEGORIES, 0.0)
+        for cats in self.per_rank.values():
+            for cat, seconds in cats.items():
+                out[cat] += seconds
+        return out
+
+    def as_dict(self) -> dict:
+        totals = self.totals()
+        spent = sum(totals.values())
+        return {"wall_s": time.monotonic() - self.started,
+                "per_rank": {r: dict(c)
+                             for r, c in sorted(self.per_rank.items())},
+                "totals": totals,
+                "ratio": (totals["productive"] / spent) if spent > 0.0
+                else 0.0}
+
+
 def spawn_worker(argv: Sequence[str], *, rank: int, log_path: str,
                  env: Optional[Mapping[str, str]] = None,
                  heartbeat_path: str = "",
@@ -244,6 +333,7 @@ class ElasticReport:
         self.hang_events = 0
         self.flights: list[str] = []
         self.world_size = 0          # final round's world size
+        self.goodput: dict = {}      # GoodputLedger.as_dict() at exit
 
     def as_dict(self) -> dict:
         return {"completed": self.completed, "rounds": self.rounds,
@@ -253,7 +343,8 @@ class ElasticReport:
                 "rejected_snapshots": list(self.rejected_snapshots),
                 "hang_events": self.hang_events,
                 "flights": list(self.flights),
-                "world_size": self.world_size}
+                "world_size": self.world_size,
+                "goodput": dict(self.goodput)}
 
 
 def _free_port(host: str) -> int:
@@ -338,6 +429,15 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
     # worker's series drop out instead of reading live forever
     aggregator = _federation.FleetAggregator(
         stale_s=max(10.0 * metrics_interval, 5.0))
+    # the goodput ledger (ISSUE 20): every supervisor second lands in
+    # exactly one znicz_goodput_* family per rank.  Children pre-touched
+    # for the whole schedule up front (the PR 11 delta-rule lesson: a
+    # fleet rule over a series that first appears mid-incident reads as
+    # a rate spike or never trips at all)
+    ledger = GoodputLedger()
+    _probe.goodput_pretouch(range(max(schedule)))
+    goodput_plane = ledger.as_dict
+    _flight.register_plane("goodput", goodput_plane)
     current: list = []       # the in-flight round's workers, shared with
     try:                     # the round loop so cleanup sees them all
         if fleet_port is not None:
@@ -350,7 +450,7 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
             spmd, coordinator_host, base_env, fault_plans, poll_s,
             term_grace, heartbeat_interval, heartbeat_timeout,
             progress_timeout, boot_timeout, round_timeout, report, log,
-            current, aggregator, metrics_interval, stop_event)
+            current, aggregator, metrics_interval, ledger, stop_event)
     finally:
         # ANY exit — completion, ElasticExhausted, KeyboardInterrupt,
         # a spawn OSError halfway through a round — must not orphan
@@ -360,7 +460,15 @@ def run_elastic(worker_argv: Sequence[str], snap_dir: str, *,
         if leaked:
             log.warning(f"elastic: reaping {len(leaked)} live worker(s) "
                         f"on supervisor exit")
+            # an abnormal exit mid-round: the round ran until now, the
+            # reap is a snapshot window (SIGTERM = snapshot-then-exit)
+            ledger.advance("productive")
             teardown_workers(leaked, term_grace, log)
+            ledger.advance("snapshot")
+        # flush the tail so the categories tile the supervisor's wall
+        ledger.advance("idle")
+        report.goodput = ledger.as_dict()
+        _flight.unregister_plane("goodput", goodput_plane)
         aggregator.close()
         _probe.elastic_world_size(0)
 
@@ -371,7 +479,8 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                       heartbeat_interval, heartbeat_timeout,
                       progress_timeout, boot_timeout, round_timeout,
                       report, log, current, aggregator,
-                      metrics_interval, stop_event=None) -> ElasticReport:
+                      metrics_interval, ledger,
+                      stop_event=None) -> ElasticReport:
     """:func:`run_elastic`'s round loop, split out so the caller's
     try/finally can guarantee teardown of ``current`` on ANY exit."""
     round_no = 0
@@ -430,7 +539,12 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                  + (f", resumed from {os.path.basename(resume)}"
                     if resume else ", cold start")
                  + (f", coordinator {coordinator}" if coordinator else ""))
+        # everything since the last stamp — the spawn loop plus the
+        # previous round's flight dump and restart backoff — is the
+        # supervisor's own overhead, charged to this round's ranks
+        ledger.advance("idle", ranks=range(world))
         round_started = time.monotonic()
+        round_wall_started = time.time()   # snapshot mtimes are wall time
         deaths: list[dict] = []
         hung: list[dict] = []
         timed_out = False
@@ -441,7 +555,9 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                 # the launcher handler turns that into one final
                 # snapshot — and return without a restart
                 log.info("elastic: stop requested; retiring the round")
+                ledger.advance("productive")   # the round ran until now
                 teardown_workers(fleet, term_grace, log)
+                ledger.advance("snapshot")     # SIGTERM grace window
                 report.rounds.append({"round": round_no, "world": world,
                                       "outcome": "stopped"})
                 report.world_size = world
@@ -473,6 +589,9 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
                 report.rounds.append({"round": round_no, "world": world,
                                       "outcome": "completed",
                                       "stragglers": stragglers})
+                # the whole round window — including the straggler
+                # grace — is productive: the job's output is complete
+                ledger.advance("productive")
                 report.completed = True
                 report.world_size = world   # gauge zeroed by the caller
                 log.info(f"elastic: completed at world size {world} "
@@ -536,7 +655,22 @@ def _supervise_rounds(worker_argv, snap_dir, schedule, policy, prefix,
         if timed_out:
             log.warning(f"elastic: round {round_no} exceeded "
                         f"{round_timeout}s; restarting")
+        # goodput split for the failed round: productive up to the
+        # newest snapshot that survives validation (that compute is
+        # KEPT — the resume continues from it), lost past it (that
+        # compute is re-done).  A snapshot from an earlier round has
+        # mtime < round start and the whole window reads as lost.
+        saved = find_latest_valid_snapshot(
+            snap_dir, prefix, rejected=report.rejected_snapshots)
+        saved_s = 0.0
+        if saved is not None:
+            try:
+                saved_s = os.path.getmtime(saved) - round_wall_started
+            except OSError:
+                saved_s = 0.0
+        ledger.advance_split(saved_s, "productive", "lost")
         teardown_workers(fleet, term_grace, log)
+        ledger.advance("snapshot")     # SIGTERM grace window
         report.rounds.append({
             "round": round_no, "world": world, "outcome": "failed",
             "deaths": deaths, "hung": hung, "timed_out": timed_out})

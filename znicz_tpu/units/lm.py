@@ -42,7 +42,8 @@ class TransformerLMStep(AcceleratedUnit):
                  n_experts: Optional[int] = None,
                  moe_aux_weight: float = 0.0,
                  moe_top_k: int = 1,
-                 moe_zloss_weight: float = 0.0, **kwargs) -> None:
+                 moe_zloss_weight: float = 0.0,
+                 anatomy: Optional[bool] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.loader = loader
         self.n_layers = int(n_layers)
@@ -70,6 +71,12 @@ class TransformerLMStep(AcceleratedUnit):
                 "moe_aux_weight/moe_zloss_weight/moe_top_k have no "
                 "effect without "
                 "n_experts — a dense model would train silently")
+        #: step-anatomy split-dispatch mode (ISSUE 20): the train step
+        #: runs as per-phase programs with host stamps feeding
+        #: znicz_anatomy_*{plane="transformer"} — explicit-psum
+        #: reduction semantics, see make_train_step(anatomy=True).
+        #: None -> root.common.engine.step_anatomy (False).
+        self.anatomy = anatomy
         self.vocab_size: Optional[int] = None
         # decision links (DecisionMSE contract)
         self.minibatch_mse = 0.0
@@ -101,6 +108,11 @@ class TransformerLMStep(AcceleratedUnit):
                 prng.get(), self.n_layers, self.d, self.heads, self.ff,
                 self.vocab_size, n_experts=self.n_experts)
         self._params = self._place_params(self._params)
+        from znicz_tpu.core.config import root
+
+        if self.anatomy is None:
+            self.anatomy = bool(root.common.engine.get("step_anatomy",
+                                                       False))
         # masked=True: the loader's padded tail rows (base.py static-shape
         # policy) contribute neither loss nor gradients
         self._step, _ = tfm.make_train_step(
@@ -110,7 +122,8 @@ class TransformerLMStep(AcceleratedUnit):
             n_experts=self.n_experts,
             moe_aux_weight=self.moe_aux_weight,
             moe_top_k=self.moe_top_k,
-            moe_zloss_weight=self.moe_zloss_weight)
+            moe_zloss_weight=self.moe_zloss_weight,
+            anatomy=bool(self.anatomy))
         self._eval = tfm.make_eval_loss(
             self.mesh, self.n_layers, self.d, self.heads, self.ff,
             self.vocab_size, masked=True, loss_chunks=self.loss_chunks,
@@ -185,6 +198,16 @@ class TransformerLMStep(AcceleratedUnit):
             # pipelined feeding: the prefetch worker already issued the
             # fused tuple put, overlapped with the previous step
             tokens, labels, mask = staged["lm"]
+        elif self.anatomy:
+            import time
+
+            from znicz_tpu.observe import probe
+            t0 = time.perf_counter()
+            tokens, labels, mask = self._stage_batch(
+                loader.minibatch_data.mem, loader.minibatch_labels.mem,
+                count)
+            probe.anatomy_phase("transformer", "stage",
+                                time.perf_counter() - t0, t0=t0)
         else:
             tokens, labels, mask = self._stage_batch(
                 loader.minibatch_data.mem, loader.minibatch_labels.mem,

@@ -27,10 +27,28 @@ TPU_PEAK_FLOPS = {
 }
 
 
+#: explicit per-chip peak override (FLOPs/s, float literal).  This is
+#: how the CPU fallback gets a *nominal* denominator so MFU stays a
+#: live, comparable-within-one-host number instead of silently absent —
+#: an MFU computed against it is NOT comparable across machines and the
+#: docs say so (OBSERVABILITY.md "Step anatomy & goodput").
+PEAK_FLOPS_ENV = "ZNICZ_TPU_PEAK_FLOPS"
+
+
 def peak_flops(gen: str | None = None) -> float | None:
     """Per-chip peak for ``gen`` ($PALLAS_AXON_TPU_GEN when unset, then the
     live ``device_kind`` — a renamed env var must not silently drop the
-    metric the round is judged on)."""
+    metric the round is judged on).  ``$ZNICZ_TPU_PEAK_FLOPS`` wins over
+    everything: the nominal-denominator escape hatch for backends (CPU)
+    whose peak the table cannot know."""
+    env = os.environ.get(PEAK_FLOPS_ENV, "")
+    if env:
+        try:
+            val = float(env)
+        except ValueError:
+            val = 0.0
+        if val > 0.0:
+            return val
     gen = gen or os.environ.get("PALLAS_AXON_TPU_GEN", "")
     if gen in TPU_PEAK_FLOPS:
         return TPU_PEAK_FLOPS[gen]

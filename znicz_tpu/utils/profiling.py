@@ -111,7 +111,11 @@ def compare_traces(logdir_a: str, logdir_b: str,
     the whole step and would double-count every contained op.  Category
     totals aggregate the FULL op list by default — truncating per-trace
     at top-N would show spurious deltas for categories whose ops fall
-    below the cutoff in one trace only."""
+    below the cutoff in one trace only.  A category present in only ONE
+    trace (an op class a rewrite added or fused away entirely) is a
+    legitimate diff outcome, not an error: its missing side reads 0.0
+    and the whole total lands in ``delta_ms`` (pinned by
+    tests/test_profiling.py)."""
     out: dict[str, list] = collections.defaultdict(lambda: [0.0, 0.0])
     for i, logdir in enumerate((logdir_a, logdir_b)):
         for r in summarize_trace(logdir, top=top):
